@@ -1,0 +1,34 @@
+"""TPU-native LLM-serving provisioner framework.
+
+A from-scratch, TPU-first rebuild of the capabilities of
+``redhat-et/aws-k8s-ansible-provisioner`` (see ``SURVEY.md``): the reference is a
+one-command AWS GPU provisioner (``deploy-k8s-cluster.sh:93-117``) that delegates the
+actual LLM engine to the external vLLM/CUDA container stack (``llm-d-deploy.yaml:176-193``).
+This package supplies the TPU-native equivalent of *both* halves:
+
+- ``serving/``: an in-repo JAX/XLA serving engine (the reference's external vLLM
+  replacement): paged KV cache, continuous batching, Pallas attention kernels, an
+  OpenAI-compatible HTTP server and Prometheus metrics on port 8000 (the scrape
+  contract from ``otel-observability-setup.yaml:359-368``).
+- ``models/``: JAX model definitions (Qwen3 family, Phi-2) + HF safetensors loading.
+- ``ops/``: attention/sampling ops, Pallas TPU kernels.
+- ``parallel/``: ``jax.sharding`` mesh construction, tensor/data/sequence-parallel
+  partition specs, XLA-collective-based distributed backend (the NCCL equivalent,
+  SURVEY.md §2.3).
+- ``utils/``: tokenizers, config, logging, Prometheus text encoding.
+
+The provisioning half (bash CLI + Ansible playbooks, the reference's L0-L5 layers)
+lives in ``deploy/`` at the repo root and consumes this package's container entry
+points.
+"""
+
+__version__ = "0.1.0"
+
+from aws_k8s_ansible_provisioner_tpu.config import (  # noqa: F401
+    FrameworkConfig,
+    ModelConfig,
+    ServingConfig,
+    MeshConfig,
+    get_model_config,
+    MODEL_REGISTRY,
+)

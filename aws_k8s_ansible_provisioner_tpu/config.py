@@ -1,0 +1,337 @@
+"""Single-source configuration for the whole framework.
+
+The reference repo couples its layers by *duplicated literals* — e.g.
+``kubernetes_version: "1.33"`` appears at ``kubernetes-single-node.yaml:7``, ``:226``
+and ``llm-d-deploy.yaml:8``; the namespace ``llm-d`` at ``llm-d-deploy.yaml:114``,
+``llm-d-test.yaml:6`` and ``otel-observability-setup.yaml:9``; the model id
+``Qwen/Qwen3-0.6B`` at ``llm-d-deploy.yaml:118`` and ``llm-d-test.yaml:7`` (SURVEY.md
+§1 "Key structural fact"). This module is the fix: every tunable the Python engine
+uses, and every value the deploy layer shares with it, is defined exactly once here.
+``python -m aws_k8s_ansible_provisioner_tpu.config --ansible-vars`` emits the same
+values as Ansible-consumable YAML so the playbooks in ``deploy/`` never hard-code
+them either.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+# ---------------------------------------------------------------------------
+# Model architecture configs
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters for a decoder-only LM.
+
+    One schema covers both model families the reference stack exercises:
+    the served default Qwen/Qwen3-0.6B (``llm-d-deploy.yaml:118``) and the two
+    chat-template targets (``templates/phi-chat-template.yaml``,
+    ``templates/opt-chat-template.yaml``) — Phi-2 being the canonical "phi"
+    template user. Field semantics:
+
+    - ``norm``: "rmsnorm" (Qwen) or "layernorm" (Phi, with bias).
+    - ``qk_norm``: per-head RMSNorm on q/k projections (Qwen3 innovation).
+    - ``parallel_block``: Phi-style parallel attention+MLP residual block.
+    - ``rotary_pct``: fraction of head_dim that is rotated (Phi-2 uses 0.4);
+      1.0 means full-dim RoPE (Qwen).
+    - ``act``: "silu" → SwiGLU gated MLP; "gelu_new" → plain 2-matrix MLP.
+    """
+
+    name: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rotary_pct: float = 1.0
+    norm: str = "rmsnorm"
+    norm_eps: float = 1e-6
+    qk_norm: bool = False
+    act: str = "silu"
+    attention_bias: bool = False
+    mlp_bias: bool = False
+    parallel_block: bool = False
+    tie_embeddings: bool = False
+    bos_token_id: Optional[int] = None
+    eos_token_id: int = 0
+    hf_repo: str = ""
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def scaled(self, **overrides) -> "ModelConfig":
+        """Return a copy with fields overridden (used for tiny test configs)."""
+        return dataclasses.replace(self, **overrides)
+
+
+# Real architectures. Hyperparameters are the public HF config.json values for each
+# model id (architecture facts, not code, so no copying concern).
+QWEN3_0_6B = ModelConfig(
+    name="Qwen/Qwen3-0.6B",
+    vocab_size=151936,
+    hidden_size=1024,
+    intermediate_size=3072,
+    num_layers=28,
+    num_heads=16,
+    num_kv_heads=8,
+    head_dim=128,
+    max_seq_len=40960,
+    rope_theta=1e6,
+    qk_norm=True,
+    tie_embeddings=True,
+    bos_token_id=151643,
+    eos_token_id=151645,
+    hf_repo="Qwen/Qwen3-0.6B",
+)
+
+QWEN3_8B = ModelConfig(
+    name="Qwen/Qwen3-8B",
+    vocab_size=151936,
+    hidden_size=4096,
+    intermediate_size=12288,
+    num_layers=36,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    max_seq_len=40960,
+    rope_theta=1e6,
+    qk_norm=True,
+    tie_embeddings=False,
+    bos_token_id=151643,
+    eos_token_id=151645,
+    hf_repo="Qwen/Qwen3-8B",
+)
+
+PHI_2 = ModelConfig(
+    name="microsoft/phi-2",
+    vocab_size=51200,
+    hidden_size=2560,
+    intermediate_size=10240,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=32,
+    head_dim=80,
+    max_seq_len=2048,
+    rope_theta=10000.0,
+    rotary_pct=0.4,
+    norm="layernorm",
+    norm_eps=1e-5,
+    act="gelu_new",
+    attention_bias=True,
+    mlp_bias=True,
+    parallel_block=True,
+    tie_embeddings=False,
+    bos_token_id=50256,
+    eos_token_id=50256,
+    hf_repo="microsoft/phi-2",
+)
+
+MODEL_REGISTRY = {
+    "Qwen/Qwen3-0.6B": QWEN3_0_6B,
+    "Qwen/Qwen3-8B": QWEN3_8B,
+    "microsoft/phi-2": PHI_2,
+}
+
+
+def get_model_config(name: str) -> ModelConfig:
+    if name not in MODEL_REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r}; registered: {sorted(MODEL_REGISTRY)}"
+        )
+    return MODEL_REGISTRY[name]
+
+
+def tiny_qwen3(**overrides) -> ModelConfig:
+    """A miniature Qwen3-shaped config for unit tests (CPU-fast, GQA exercised)."""
+    base = dict(
+        name="tiny-qwen3",
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_seq_len=128,
+        rope_theta=1e6,
+        qk_norm=True,
+        tie_embeddings=True,
+        eos_token_id=1,
+    )
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+def tiny_phi(**overrides) -> ModelConfig:
+    """A miniature Phi-2-shaped config (parallel block, partial rotary, biases)."""
+    base = dict(
+        name="tiny-phi",
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=4,
+        head_dim=16,
+        max_seq_len=128,
+        rope_theta=10000.0,
+        rotary_pct=0.5,
+        norm="layernorm",
+        norm_eps=1e-5,
+        act="gelu_new",
+        attention_bias=True,
+        mlp_bias=True,
+        parallel_block=True,
+        eos_token_id=1,
+    )
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# Mesh / parallelism config
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh (SURVEY.md §2.3: every parallelism capability is net-new).
+
+    Axes: ``dp`` data-parallel replicas, ``tp`` tensor parallel over ICI, ``sp``
+    sequence/context parallel (ring attention). The product must equal the device
+    count. The communication backend is XLA collectives emitted by the compiler
+    from these shardings — nothing to install (replaces the reference stack's
+    implicit NCCL, SURVEY.md §5 "Distributed communication backend").
+    """
+
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp * self.sp
+
+    @property
+    def axis_names(self):
+        return ("dp", "tp", "sp")
+
+
+# ---------------------------------------------------------------------------
+# Serving config (engine + deploy-layer shared values)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """Engine runtime knobs + the values shared with the deploy layer."""
+
+    model: str = "Qwen/Qwen3-0.6B"
+    # HTTP serving port — must stay 8000: the OTEL collector's annotation-gated pod
+    # scrape defaults to port 8000 (reference otel-observability-setup.yaml:359-368)
+    # and our observability playbook preserves that contract.
+    port: int = 8000
+    host: str = "0.0.0.0"
+    # Decode slots = max concurrent sequences in flight (continuous batching).
+    max_decode_slots: int = 32
+    # Prefill length buckets (powers of two): requests are right-padded to the
+    # smallest bucket ≥ prompt length so XLA compiles a fixed set of programs.
+    prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024, 2048)
+    # Max tokens of KV cache per slot (static decode shape).
+    max_cache_len: int = 2048
+    # Paged KV cache geometry.
+    page_size: int = 64
+    max_tokens_default: int = 256
+    dtype: str = "bfloat16"
+    # Attention backend: "xla" (fused SDPA fallback) or "pallas" (custom kernel).
+    attention_impl: str = "auto"
+    checkpoint_dir: str = ""
+    chat_template: str = ""  # path to a .jinja file; empty = model family default
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+
+# ---------------------------------------------------------------------------
+# Deploy-layer config (the values the reference duplicated across playbooks)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DeployConfig:
+    """Values consumed by deploy/*.yaml via `--ansible-vars` emission.
+
+    Mirrors (TPU-retargeted) the reference's per-playbook vars blocks:
+    kubernetes/CRI-O versions (kubernetes-single-node.yaml:6-17), namespaces
+    (llm-d-deploy.yaml:114, otel-observability-setup.yaml:7-12), the served model
+    (llm-d-deploy.yaml:113-118), gateway naming (llm-d-test.yaml:5-7).
+    """
+
+    # GCP / TPU provisioning (replaces AWS vars at launch-instance.yaml:6-13).
+    gcp_project: str = "CHANGE-ME"
+    gcp_zone: str = "us-east5-b"
+    tpu_accelerator_type: str = "v5litepod-8"
+    tpu_runtime_version: str = "v2-alpha-tpuv5-lite"
+    tpu_name_prefix: str = "tpu-llm"
+    boot_disk_gb: int = 500
+    ssh_user: str = "ubuntu"
+    # Cluster substrate (same shape as reference kubernetes-single-node.yaml:6-12).
+    kubernetes_version: str = "1.33"
+    crio_version: str = "1.33"
+    pod_network_cidr: str = "192.168.0.0/16"
+    # Serving stack. NOTE: the served model id and port live in ServingConfig (the
+    # engine is the authority); ansible_vars() merges them in — no second copy here.
+    serving_namespace: str = "tpu-serve"
+    gateway_name: str = "tpu-inference-gateway"
+    storage_class: str = "local-path"
+    model_storage_gi: int = 100
+    # Observability.
+    otel_namespace: str = "otel-monitoring"
+    observability_namespace: str = "observability"
+    cluster_name: str = "tpu-cluster"
+    metrics_scrape_interval_s: int = 5
+
+
+@dataclass(frozen=True)
+class FrameworkConfig:
+    serving: ServingConfig = field(default_factory=ServingConfig)
+    deploy: DeployConfig = field(default_factory=DeployConfig)
+
+
+def ansible_vars(cfg: FrameworkConfig | None = None) -> str:
+    """Render DeployConfig (+ shared serving values) as YAML for ansible extra-vars."""
+    cfg = cfg or FrameworkConfig()
+    d = dataclasses.asdict(cfg.deploy)
+    # Values the deploy layer shares with the engine come FROM the engine config —
+    # a single source, unlike the reference's duplicated literals (SURVEY.md §1).
+    d["model"] = cfg.serving.model
+    d["serving_port"] = cfg.serving.port
+    lines = ["# generated by aws_k8s_ansible_provisioner_tpu.config — do not edit"]
+    for k, v in d.items():
+        lines.append(f"{k}: {json.dumps(v)}")
+    return "\n".join(lines) + "\n"
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ansible-vars", action="store_true",
+                   help="emit deploy-layer vars as YAML")
+    args = p.parse_args()
+    if args.ansible_vars:
+        print(ansible_vars(), end="")
+    else:
+        print(json.dumps(dataclasses.asdict(FrameworkConfig()), indent=2, default=str))

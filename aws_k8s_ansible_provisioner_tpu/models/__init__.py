@@ -1,0 +1,26 @@
+"""Model definitions: generic decoder stack + per-family configs.
+
+The two families mirror what the reference stack serves/templates:
+Qwen3 (served model, ``llm-d-deploy.yaml:118``) and Phi-2 (the
+``templates/phi-chat-template.yaml`` target). Both share one functional decoder
+(`layers.model_forward`); family differences are pure config (norm type, RoPE
+fraction, parallel block, biases) — no per-family forward code to keep in sync.
+"""
+
+from aws_k8s_ansible_provisioner_tpu.models.layers import (  # noqa: F401
+    model_forward,
+    init_params,
+    param_count,
+    causal_attend,
+    decoder_block,
+    rms_norm,
+    layer_norm,
+    apply_rope,
+    rope_cos_sin,
+    repeat_kv,
+)
+from aws_k8s_ansible_provisioner_tpu.models.hf_loader import (  # noqa: F401
+    convert_state_dict,
+    load_checkpoint,
+    config_from_hf_dir,
+)

@@ -1,0 +1,205 @@
+"""HuggingFace checkpoint → JAX param-pytree conversion.
+
+The reference downloads model weights once via its external installer
+(``llm-d-deploy.yaml:184`` ``--download-model Qwen/Qwen3-0.6B``) into a PVC and lets
+vLLM do the loading. In the TPU build, loading is in-repo: safetensors →
+``models/layers.py`` layout (``[in, out]`` kernels, stacked ``[L, ...]`` layer
+axes), optionally placed shard-by-shard onto a ``jax.sharding.Mesh`` so an 8B
+checkpoint never materializes unsharded on one host (SURVEY.md §7 hard part #3).
+
+Key-name maps cover both supported families:
+- Qwen3*: ``model.layers.N.self_attn.{q,k,v,o}_proj``, ``q_norm``/``k_norm``,
+  gated ``mlp.{gate,up,down}_proj``, RMSNorm weights.
+- Phi-2: ``self_attn.dense``, ``mlp.fc1/fc2`` with biases, LayerNorm
+  weight+bias, ``lm_head`` with bias, no post-attention norm (parallel block).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
+
+
+def _np(x):
+    """torch tensor / np array -> float32 numpy (bf16-safe)."""
+    if hasattr(x, "detach"):
+        x = x.detach().to("cpu")
+        try:
+            import torch
+
+            if x.dtype == torch.bfloat16:
+                x = x.float()
+        except Exception:
+            pass
+        x = x.numpy()
+    return np.asarray(x)
+
+
+def _get(tensors: Dict[str, "np.ndarray"], key: str) -> np.ndarray:
+    if key not in tensors:
+        raise KeyError(f"missing weight {key!r}; have e.g. "
+                       f"{sorted(tensors)[:8]} ...")
+    return _np(tensors[key])
+
+
+def convert_state_dict(cfg: ModelConfig, tensors: Dict[str, np.ndarray],
+                       dtype=jnp.bfloat16) -> dict:
+    """Convert a flat HF state dict (torch tensors or numpy) to our pytree."""
+    phi = cfg.parallel_block
+    L = cfg.num_layers
+
+    def stack(fmt: str, transpose: bool) -> np.ndarray:
+        mats = []
+        for i in range(L):
+            w = _get(tensors, fmt.format(i=i))
+            mats.append(w.T if transpose else w)
+        return np.stack(mats)
+
+    if phi:
+        pre = "model.layers.{i}.self_attn."
+        o_name, up_name, down_name = "dense", "mlp.fc1", "mlp.fc2"
+        final_norm = "model.final_layernorm"
+    else:
+        pre = "model.layers.{i}.self_attn."
+        o_name, up_name, down_name = "o_proj", "mlp.up_proj", "mlp.down_proj"
+        final_norm = "model.norm"
+
+    def dense(hf_fmt: str, bias: bool) -> dict:
+        p = {"kernel": stack(hf_fmt + ".weight", transpose=True)}
+        if bias:
+            p["bias"] = stack(hf_fmt + ".bias", transpose=False)
+        return p
+
+    def norm(hf_fmt: str) -> dict:
+        p = {"weight": stack(hf_fmt + ".weight", transpose=False)}
+        if cfg.norm == "layernorm":
+            p["bias"] = stack(hf_fmt + ".bias", transpose=False)
+        return p
+
+    layers: dict = {
+        "input_norm": norm("model.layers.{i}.input_layernorm"),
+        "wq": dense(pre + "q_proj", cfg.attention_bias),
+        "wk": dense(pre + "k_proj", cfg.attention_bias),
+        "wv": dense(pre + "v_proj", cfg.attention_bias),
+        "wo": dense("model.layers.{i}.self_attn." + o_name, cfg.attention_bias),
+        "w_down": dense("model.layers.{i}." + down_name, cfg.mlp_bias),
+    }
+    if cfg.act == "silu":
+        layers["w_gate"] = dense("model.layers.{i}.mlp.gate_proj", cfg.mlp_bias)
+        layers["w_up"] = dense("model.layers.{i}.mlp.up_proj", cfg.mlp_bias)
+    else:
+        layers["w_up"] = dense("model.layers.{i}." + up_name, cfg.mlp_bias)
+    if cfg.qk_norm:
+        layers["q_norm"] = {"weight": stack(pre + "q_norm.weight", False)}
+        layers["k_norm"] = {"weight": stack(pre + "k_norm.weight", False)}
+    if not cfg.parallel_block:
+        layers["post_norm"] = norm("model.layers.{i}.post_attention_layernorm")
+
+    params: dict = {
+        "embed": {"weight": _get(tensors, "model.embed_tokens.weight")},
+        "layers": layers,
+        "final_norm": {"weight": _get(tensors, final_norm + ".weight")},
+    }
+    if cfg.norm == "layernorm":
+        params["final_norm"]["bias"] = _get(tensors, final_norm + ".bias")
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": _get(tensors, "lm_head.weight").T}
+        if "lm_head.bias" in tensors:
+            params["lm_head"]["bias"] = _get(tensors, "lm_head.bias")
+
+    return jax.tree.map(lambda x: jnp.asarray(x, dtype), params)
+
+
+def load_checkpoint(
+    checkpoint_dir: str,
+    cfg: ModelConfig,
+    dtype=jnp.bfloat16,
+    device_put: Optional[Callable[[str, jnp.ndarray], jnp.ndarray]] = None,
+) -> dict:
+    """Load all ``*.safetensors`` shards from a HF checkpoint directory.
+
+    ``device_put(path, arr)`` optionally places each converted leaf (path is the
+    pytree path string) — used by ``parallel.sharding`` to stream shards onto the
+    mesh without a full host-side copy of the assembled model.
+    """
+    from safetensors.numpy import load_file
+
+    tensors: Dict[str, np.ndarray] = {}
+    files = sorted(
+        f for f in os.listdir(checkpoint_dir) if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {checkpoint_dir}")
+    for f in files:
+        tensors.update(load_file(os.path.join(checkpoint_dir, f)))
+    params = convert_state_dict(cfg, tensors, dtype)
+    if device_put is not None:
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        placed = [device_put(jax.tree_util.keystr(path), leaf)
+                  for path, leaf in flat]
+        params = jax.tree_util.tree_unflatten(treedef, placed)
+    return params
+
+
+def config_from_hf_dir(checkpoint_dir: str) -> ModelConfig:
+    """Build a ModelConfig from a checkpoint's config.json (registry fallback)."""
+    from aws_k8s_ansible_provisioner_tpu.config import MODEL_REGISTRY
+
+    with open(os.path.join(checkpoint_dir, "config.json")) as fh:
+        hf = json.load(fh)
+    name = hf.get("_name_or_path") or os.path.basename(checkpoint_dir.rstrip("/"))
+    # Exact registry match only — fuzzy matching could bind e.g. a 'qwen3' dir of
+    # 8B weights to the 0.6B entry; config.json is the authority otherwise.
+    if name in MODEL_REGISTRY:
+        return MODEL_REGISTRY[name]
+    model_type = hf.get("model_type", "")
+    if model_type == "qwen3":
+        return ModelConfig(
+            name=name,
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf["num_key_value_heads"],
+            head_dim=hf.get("head_dim", hf["hidden_size"] // hf["num_attention_heads"]),
+            max_seq_len=hf.get("max_position_embeddings", 4096),
+            rope_theta=hf.get("rope_theta", 1e6),
+            qk_norm=True,
+            norm_eps=hf.get("rms_norm_eps", 1e-6),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            eos_token_id=(hf.get("eos_token_id") or 0),
+            hf_repo=name,
+        )
+    if model_type == "phi":
+        head_dim = hf["hidden_size"] // hf["num_attention_heads"]
+        return ModelConfig(
+            name=name,
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads") or hf["num_attention_heads"],
+            head_dim=head_dim,
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rotary_pct=hf.get("partial_rotary_factor", 0.4),
+            norm="layernorm",
+            norm_eps=hf.get("layer_norm_eps", 1e-5),
+            act="gelu_new",
+            attention_bias=True,
+            mlp_bias=True,
+            parallel_block=True,
+            eos_token_id=(hf.get("eos_token_id") or 0),
+            hf_repo=name,
+        )
+    raise ValueError(f"unsupported model_type {model_type!r} in {checkpoint_dir}")

@@ -1,0 +1,312 @@
+"""Core decoder-only transformer in functional JAX, designed TPU-first.
+
+This is the heart of the serving engine the reference delegates to the external
+vLLM CUDA container (SURVEY.md §2.2 row 1: "JAX/XLA serving engine" is the
+TPU-native equivalent to build). Design choices for the TPU/XLA compilation model:
+
+- **Scanned layers**: all layer weights are stacked with a leading ``[L, ...]`` axis
+  and the decoder runs as one ``lax.scan`` over layers — one compiled layer body
+  instead of 28-36 unrolled copies (compile time, HLO size) and a natural remat
+  boundary (``jax.checkpoint`` over the scan body).
+- **Static shapes everywhere**: no data-dependent Python control flow; masks and
+  position arrays express raggedness. This is what lets XLA tile matmuls onto the
+  MXU without re-specialization.
+- **bfloat16 weights/activations, float32 softmax & norms**: MXU-native precision
+  with numerically safe reductions.
+- **Pluggable attention**: ``model_forward`` takes an ``attend`` callback so the
+  same layer stack serves full causal prefill (training/parity tests), cached
+  decode against the paged KV cache, and the Pallas kernel path, without
+  duplicating the transformer block.
+
+Weight layout is ``[in_features, out_features]`` (``x @ W``), i.e. transposed from
+torch ``nn.Linear``; ``models/hf_loader.py`` handles the conversion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
+
+# An attend callback: (q [B,T,Hq,D], k [B,T,Hkv,D], v [B,T,Hkv,D], layer_cache)
+# -> (context [B,T,Hq,D], new_layer_cache). q/k are already RoPE'd and qk-normed.
+AttendFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray, Any],
+                    Tuple[jnp.ndarray, Any]]
+
+
+# ---------------------------------------------------------------------------
+# Norms
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """RMSNorm with float32 accumulation (matches HF Qwen3 semantics)."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
+               eps: float) -> jnp.ndarray:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
+def apply_norm(cfg: ModelConfig, x: jnp.ndarray, p: dict) -> jnp.ndarray:
+    if cfg.norm == "rmsnorm":
+        return rms_norm(x, p["weight"], cfg.norm_eps)
+    return layer_norm(x, p["weight"], p["bias"], cfg.norm_eps)
+
+
+# ---------------------------------------------------------------------------
+# Rotary position embeddings
+# ---------------------------------------------------------------------------
+
+
+def rope_cos_sin(positions: jnp.ndarray, rotary_dim: int,
+                 theta: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for given integer positions. positions: [B, T] or [T]."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim)
+    )
+    # [..., T, rotary_dim/2]
+    freqs = positions[..., None].astype(jnp.float32) * inv_freq
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # HF "rotate_half" convention
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+               rotary_dim: int) -> jnp.ndarray:
+    """Apply (possibly partial) RoPE. x: [B, T, H, D]; cos/sin: [B, T, rotary_dim].
+
+    Partial rotation (Phi-2's rotary_pct=0.4, HF PhiAttention behavior): only the
+    first ``rotary_dim`` features of each head rotate; the rest pass through.
+    """
+    dtype = x.dtype
+    rot = x[..., :rotary_dim].astype(jnp.float32)
+    cos = cos[..., None, :]  # broadcast over heads: [B, T, 1, rotary_dim]
+    sin = sin[..., None, :]
+    rot = rot * cos + _rotate_half(rot) * sin
+    if rotary_dim == x.shape[-1]:
+        return rot.astype(dtype)
+    return jnp.concatenate([rot.astype(dtype), x[..., rotary_dim:]], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Dense attention (prefill / training / parity path)
+# ---------------------------------------------------------------------------
+
+
+def repeat_kv(k: jnp.ndarray, num_heads: int) -> jnp.ndarray:
+    """[B, T, Hkv, D] -> [B, T, Hq, D] by repeating each kv head."""
+    num_kv = k.shape[-2]
+    if num_kv == num_heads:
+        return k
+    return jnp.repeat(k, num_heads // num_kv, axis=-2)
+
+
+def causal_attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                  seq_lens: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Full causal self-attention over the current window.
+
+    q: [B, T, Hq, D]; k/v: [B, T, Hkv, D]. ``seq_lens`` optionally masks padded
+    tail positions (right padding). float32 softmax.
+    """
+    B, T, Hq, D = q.shape
+    k = repeat_kv(k, Hq)
+    v = repeat_kv(v, Hq)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    pos = jnp.arange(T)
+    mask = pos[None, :] <= pos[:, None]  # [Tq, Tk] causal
+    if seq_lens is not None:
+        valid = pos[None, :] < seq_lens[:, None]  # [B, Tk]
+        mask = mask[None, :, :] & valid[:, None, :]
+        mask = mask[:, None, :, :]  # [B, 1, Tq, Tk]
+    else:
+        mask = mask[None, None, :, :]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return ctx.astype(q.dtype)
+
+
+def _default_attend(q, k, v, cache):
+    return causal_attend(q, k, v), cache
+
+
+# ---------------------------------------------------------------------------
+# Parameter init
+# ---------------------------------------------------------------------------
+
+
+def _dense_init(key, shape, dtype, scale=0.02):
+    return (scale * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+
+
+def init_layer_params(cfg: ModelConfig, key: jax.Array, dtype) -> dict:
+    """Init stacked layer params: every leaf has leading [num_layers] axis."""
+    L, H = cfg.num_layers, cfg.hidden_size
+    ks = jax.random.split(key, 8)
+
+    def dense(k, din, dout, bias):
+        p = {"kernel": _dense_init(k, (L, din, dout), dtype)}
+        if bias:
+            p["bias"] = jnp.zeros((L, dout), dtype)
+        return p
+
+    def norm():
+        p = {"weight": jnp.ones((L, H), dtype)}
+        if cfg.norm == "layernorm":
+            p["bias"] = jnp.zeros((L, H), dtype)
+        return p
+
+    params = {
+        "input_norm": norm(),
+        "wq": dense(ks[0], H, cfg.q_size, cfg.attention_bias),
+        "wk": dense(ks[1], H, cfg.kv_size, cfg.attention_bias),
+        "wv": dense(ks[2], H, cfg.kv_size, cfg.attention_bias),
+        "wo": dense(ks[3], cfg.q_size, H, cfg.attention_bias),
+    }
+    if cfg.qk_norm:
+        params["q_norm"] = {"weight": jnp.ones((L, cfg.head_dim), dtype)}
+        params["k_norm"] = {"weight": jnp.ones((L, cfg.head_dim), dtype)}
+    if cfg.act == "silu":  # gated SwiGLU MLP (Qwen)
+        params["w_gate"] = dense(ks[4], H, cfg.intermediate_size, cfg.mlp_bias)
+        params["w_up"] = dense(ks[5], H, cfg.intermediate_size, cfg.mlp_bias)
+    else:  # plain 2-matmul MLP (Phi)
+        params["w_up"] = dense(ks[5], H, cfg.intermediate_size, cfg.mlp_bias)
+    params["w_down"] = dense(ks[6], cfg.intermediate_size, H, cfg.mlp_bias)
+    if not cfg.parallel_block:
+        params["post_norm"] = norm()
+    return params
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    params = {
+        "embed": {"weight": _dense_init(k_embed, (cfg.vocab_size, cfg.hidden_size),
+                                        dtype)},
+        "layers": init_layer_params(cfg, k_layers, dtype),
+        "final_norm": {"weight": jnp.ones((cfg.hidden_size,), dtype)},
+    }
+    if cfg.norm == "layernorm":
+        params["final_norm"]["bias"] = jnp.zeros((cfg.hidden_size,), dtype)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {
+            "kernel": _dense_init(k_head, (cfg.hidden_size, cfg.vocab_size), dtype)
+        }
+        if cfg.parallel_block:  # HF PhiForCausalLM lm_head has bias=True
+            params["lm_head"]["bias"] = jnp.zeros((cfg.vocab_size,), dtype)
+    return params
+
+
+def param_count(params) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# Forward pass
+# ---------------------------------------------------------------------------
+
+
+def _linear(x, p):
+    y = x @ p["kernel"]
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+def _mlp(cfg: ModelConfig, h: jnp.ndarray, p: dict) -> jnp.ndarray:
+    if cfg.act == "silu":
+        return _linear(jax.nn.silu(_linear(h, p["w_gate"])) * _linear(h, p["w_up"]),
+                       p["w_down"])
+    act = partial(jax.nn.gelu, approximate=True)  # HF "gelu_new"
+    return _linear(act(_linear(h, p["w_up"])), p["w_down"])
+
+
+def decoder_block(cfg: ModelConfig, p: dict, x: jnp.ndarray,
+                  cos: jnp.ndarray, sin: jnp.ndarray,
+                  attend: AttendFn, cache_l: Any) -> Tuple[jnp.ndarray, Any]:
+    """One transformer block. ``p`` is a per-layer slice (no leading L axis)."""
+    B, T, _ = x.shape
+    rotary_dim = int(cfg.head_dim * cfg.rotary_pct)
+
+    h = apply_norm(cfg, x, p["input_norm"])
+    q = _linear(h, p["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = _linear(h, p["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = _linear(h, p["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:  # per-head RMSNorm on q/k (Qwen3)
+        q = rms_norm(q, p["q_norm"]["weight"], cfg.norm_eps)
+        k = rms_norm(k, p["k_norm"]["weight"], cfg.norm_eps)
+    q = apply_rope(q, cos, sin, rotary_dim)
+    k = apply_rope(k, cos, sin, rotary_dim)
+
+    ctx, new_cache_l = attend(q, k, v, cache_l)
+    attn_out = _linear(ctx.reshape(B, T, cfg.q_size), p["wo"])
+
+    if cfg.parallel_block:  # Phi: attn and MLP both read the same normed input
+        x = x + attn_out + _mlp(cfg, h, p)
+    else:
+        x = x + attn_out
+        h2 = apply_norm(cfg, x, p["post_norm"])
+        x = x + _mlp(cfg, h2, p)
+    return x, new_cache_l
+
+
+def model_forward(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,          # [B, T] int32
+    positions: jnp.ndarray,       # [B, T] int32 (absolute positions for RoPE)
+    cache: Any = None,            # pytree with leading [L] axis per leaf, or None
+    attend: Optional[AttendFn] = None,
+    remat: bool = False,
+) -> Tuple[jnp.ndarray, Any]:
+    """Run the decoder; returns (logits [B, T, V], updated cache)."""
+    attend = attend or _default_attend
+    x = params["embed"]["weight"][tokens]
+    rotary_dim = int(cfg.head_dim * cfg.rotary_pct)
+    cos, sin = rope_cos_sin(positions, rotary_dim, cfg.rope_theta)
+
+    def body(x, layer_in):
+        p_l, cache_l = layer_in
+        x, new_cache_l = decoder_block(cfg, p_l, x, cos, sin, attend, cache_l)
+        return x, new_cache_l
+
+    if remat:
+        body = jax.checkpoint(body)
+
+    if cache is None:
+        # scan needs a pytree of xs with a leading L axis; use a dummy per-layer
+        # placeholder so `attend` implementations can ignore it.
+        dummy = jnp.zeros((cfg.num_layers,), jnp.int32)
+        x, _ = jax.lax.scan(body, x, (params["layers"], dummy))
+        new_cache = None
+    else:
+        x, new_cache = jax.lax.scan(body, x, (params["layers"], cache))
+
+    x = apply_norm(cfg, x, params["final_norm"])
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["weight"].T
+    else:
+        logits = _linear(x, params["lm_head"])
+    return logits, new_cache

@@ -1,0 +1,94 @@
+"""Tokenizer abstraction: HF tokenizer when a checkpoint is present, byte fallback.
+
+The reference never touches tokenization — it lives inside the external vLLM
+container (SURVEY.md §0). Our engine owns it. Because the serving pod may run in an
+air-gapped environment (and our CI has zero egress), every code path must work
+without HuggingFace Hub access: `ByteTokenizer` is a self-contained byte-level
+tokenizer used for tests/benchmarks, and `load_tokenizer` upgrades to the model's
+real `AutoTokenizer` when `checkpoint_dir` contains tokenizer files.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer: token id = byte value; specials live above 255.
+
+    Deterministic, vocabulary 256 + 3 specials. Round-trips arbitrary UTF-8.
+    """
+
+    PAD = 256
+    BOS = 257
+    EOS = 258
+
+    vocab_size = 259
+    pad_token_id = PAD
+    bos_token_id = BOS
+    eos_token_id = EOS
+    name = "byte-fallback"
+
+    def encode(self, text: str, add_bos: bool = False) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.BOS] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        data = bytes(i for i in ids if i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages, add_generation_prompt=True, **kw) -> str:
+        # Plain concatenation; real chat formatting is handled by the serving
+        # layer's Jinja templates (serving/chat_template.py).
+        parts = [f"{m['role']}: {m['content']}" for m in messages]
+        if add_generation_prompt:
+            parts.append("assistant:")
+        return "\n".join(parts)
+
+
+class HFTokenizer:
+    """Thin wrapper unifying the transformers tokenizer interface."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self._tok)
+        self.pad_token_id = self._tok.pad_token_id
+        self.bos_token_id = self._tok.bos_token_id
+        self.eos_token_id = self._tok.eos_token_id
+        self.name = path
+
+    def encode(self, text: str, add_bos: bool = False) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if add_bos and self.bos_token_id is not None:
+            ids = [self.bos_token_id] + ids
+        return ids
+
+    def decode(self, ids, skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(ids, skip_special_tokens=skip_special_tokens)
+
+    def apply_chat_template(self, messages, add_generation_prompt=True, **kw):
+        return self._tok.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=add_generation_prompt, **kw
+        )
+
+
+def load_tokenizer(checkpoint_dir: Optional[str] = None):
+    """Return the checkpoint's tokenizer if available, else the byte fallback.
+
+    A failed load of an *existing* checkpoint tokenizer is loud: silently serving a
+    real model with the byte fallback would produce garbage token ids with no clue
+    why (the model's eos id can never appear), so the downgrade is logged.
+    """
+    if checkpoint_dir:
+        try:
+            return HFTokenizer(checkpoint_dir)
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "failed to load tokenizer from %s (%s: %s); falling back to "
+                "byte-level tokenizer — generations from a real checkpoint will "
+                "be wrong", checkpoint_dir, type(e).__name__, e)
+    return ByteTokenizer()

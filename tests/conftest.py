@@ -1,0 +1,33 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+The reference has no offline test substrate at all (SURVEY.md §4: "no unit tests, no
+CI config, no mocks"); its only gate is a live cluster smoke test. We do better per
+SURVEY.md §4's recommendation: the whole engine runs under JAX_PLATFORMS=cpu with 8
+virtual devices so sharding/parallelism is testable with zero TPUs.
+"""
+
+import os
+
+# Must run before JAX initializes its backend. The outer environment points JAX at
+# the real TPU chip (and its plugin wins over the JAX_PLATFORMS env var), so force
+# CPU via jax.config — unit tests are defined to run on the virtual CPU mesh; TPU
+# default matmul precision would also break float32 parity tolerances. bench.py is
+# the real-chip path.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

@@ -1,0 +1,119 @@
+"""Numerical parity of our JAX decoder vs HuggingFace torch implementations.
+
+The reference repo has no tests (SURVEY.md §4); its only correctness gate is the
+live `/v1/models` assert (`llm-d-test.yaml:54-59`). Ours is stronger: tiny random
+instances of the real HF model classes (Qwen3ForCausalLM, PhiForCausalLM) are
+converted through `models.hf_loader` and must match logits to float tolerance —
+this pins down RoPE conventions, GQA, qk-norm, parallel blocks and bias handling
+before any weight ever loads on a TPU.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from aws_k8s_ansible_provisioner_tpu.config import tiny_qwen3, tiny_phi
+from aws_k8s_ansible_provisioner_tpu.models import convert_state_dict, model_forward
+
+
+def _hf_qwen3(cfg):
+    import torch
+    from transformers import Qwen3Config
+    from transformers.models.qwen3.modeling_qwen3 import Qwen3ForCausalLM
+
+    hf_cfg = Qwen3Config(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        rms_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rope_theta,
+        tie_word_embeddings=cfg.tie_embeddings,
+        attention_dropout=0.0,
+        use_sliding_window=False,
+    )
+    torch.manual_seed(0)
+    return Qwen3ForCausalLM(hf_cfg).eval()
+
+
+def _hf_phi(cfg):
+    import torch
+    from transformers import PhiConfig
+    from transformers.models.phi.modeling_phi import PhiForCausalLM
+
+    hf_cfg = PhiConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        partial_rotary_factor=cfg.rotary_pct,
+        layer_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rope_theta,
+        tie_word_embeddings=False,
+        attention_dropout=0.0,
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+        hidden_act="gelu_new",
+    )
+    torch.manual_seed(0)
+    return PhiForCausalLM(hf_cfg).eval()
+
+
+@pytest.mark.parametrize("family", ["qwen3", "phi"])
+def test_logits_match_hf(family):
+    import torch
+
+    cfg = tiny_qwen3() if family == "qwen3" else tiny_phi()
+    model = _hf_qwen3(cfg) if family == "qwen3" else _hf_phi(cfg)
+
+    params = convert_state_dict(cfg, dict(model.state_dict()), dtype=jnp.float32)
+
+    rng = np.random.default_rng(0)
+    B, T = 2, 17
+    tokens = rng.integers(0, cfg.vocab_size, (B, T))
+
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens)).logits.float().numpy()
+
+    positions = np.broadcast_to(np.arange(T), (B, T))
+    logits, _ = model_forward(params, cfg, jnp.asarray(tokens, jnp.int32),
+                              jnp.asarray(positions, jnp.int32))
+    got = np.asarray(logits, np.float32)
+
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_padded_prefill_matches_unpadded():
+    """Right-padded batch prefill (serving path) must match per-sequence logits."""
+    from aws_k8s_ansible_provisioner_tpu.models import causal_attend
+    from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+    import jax
+
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    lens = np.array([5, 9])
+    T = 12
+    tokens = rng.integers(0, cfg.vocab_size, (2, T))
+    positions = np.broadcast_to(np.arange(T), (2, T)).copy()
+
+    seq_lens = jnp.asarray(lens, jnp.int32)
+
+    def attend(q, k, v, cache):
+        return causal_attend(q, k, v, seq_lens=seq_lens), cache
+
+    logits, _ = model_forward(params, cfg, jnp.asarray(tokens, jnp.int32),
+                              jnp.asarray(positions, jnp.int32), attend=attend)
+
+    for b, ln in enumerate(lens):
+        solo, _ = model_forward(
+            params, cfg,
+            jnp.asarray(tokens[b:b + 1, :ln], jnp.int32),
+            jnp.asarray(positions[b:b + 1, :ln], jnp.int32))
+        np.testing.assert_allclose(
+            np.asarray(logits)[b, :ln], np.asarray(solo)[0], rtol=2e-4, atol=2e-4)
