@@ -1,0 +1,77 @@
+"""Serving attention ops: cached decode + prefill-with-cache-write.
+
+XLA-fallback implementations (portable to CPU for tests); the Pallas TPU kernel in
+``ops/pallas_attention.py`` is the performance path behind the same interface.
+These are the TPU-native equivalents of the paged-attention CUDA kernels inside
+the reference's external vLLM engine (SURVEY.md §3.3: "the true hot loop ... lives
+entirely inside the external vLLM container").
+
+Design notes (TPU/HBM-first):
+- Decode reads the cache **in place**: the GQA einsum groups query heads over
+  shared KV heads (``bkgd,bskd->bkgs``) so no ``repeat_kv`` copy and no page
+  gather materializes in HBM — the whole step stays at cache-bandwidth cost.
+- Raggedness is a ``lengths`` mask, never a dynamic shape.
+- Softmax in float32 on the VPU; matmuls in bf16 on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
+
+
+def decode_attend(q: jnp.ndarray, cache_k: jnp.ndarray, cache_v: jnp.ndarray,
+                  lengths: jnp.ndarray) -> jnp.ndarray:
+    """Cached decode attention for one new token per slot.
+
+    q: [B, 1, Hq, D]; cache_k/v: [B, S, Hkv, D] (already containing the new
+    token's k/v at position lengths-1... i.e. caller writes first); lengths: [B]
+    = number of valid rows per slot (including the new token).
+    Returns [B, 1, Hq, D].
+    """
+    B, _, Hq, D = q.shape
+    S = cache_k.shape[1]
+    Hkv = cache_k.shape[2]
+    G = Hq // Hkv
+    qg = q[:, 0].reshape(B, Hkv, G, D).astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    logits = jnp.einsum("bkgd,bskd->bkgs", qg, cache_k.astype(jnp.float32)) * scale
+    valid = jnp.arange(S)[None, :] < lengths[:, None]          # [B, S]
+    logits = jnp.where(valid[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    ctx = jnp.einsum("bkgs,bskd->bkgd", probs, cache_v.astype(jnp.float32))
+    return ctx.reshape(B, 1, Hq, D).astype(q.dtype)
+
+
+def make_decode_attend(lengths: jnp.ndarray):
+    """Attend callback for model_forward: writes the new token, then attends.
+
+    ``lengths`` are the pre-step lengths (position of the incoming token).
+    """
+
+    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
+        cache_l = kvc.write_token(cache_l, lengths, k, v)
+        ctx = decode_attend(q, cache_l["k"], cache_l["v"], lengths + 1)
+        return ctx, cache_l
+
+    return attend
+
+
+def make_prefill_attend(slot: jnp.ndarray, seq_len: jnp.ndarray):
+    """Attend callback for single-sequence prefill into one cache slot.
+
+    Causal attention over the (padded) prompt window + write of k/v rows into the
+    slot. ``seq_len`` masks right padding so padded keys never contribute.
+    """
+    from aws_k8s_ansible_provisioner_tpu.models.layers import causal_attend
+
+    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
+        ctx = causal_attend(q, k, v, seq_lens=seq_len[None])
+        cache_l = kvc.write_prompt(cache_l, slot, k, v)
+        return ctx, cache_l
+
+    return attend

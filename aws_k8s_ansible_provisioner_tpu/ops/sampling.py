@@ -1,0 +1,52 @@
+"""Token sampling: greedy / temperature / top-k / top-p, fully jittable.
+
+Equivalent of the sampling parameters the reference's OpenAI API accepts and
+forwards to vLLM (``llm-d-test.yaml:61-78`` exercises the endpoint with
+``max_tokens``; vLLM handles temperature/top_p/top_k). TPU-first details:
+
+- Per-request parameters are vectors ``[B]`` so one compiled program serves any
+  mix of greedy and sampled requests in a continuous batch (no re-jit).
+- top-k/top-p run on a static ``MAX_TOPK`` candidate set from ``lax.top_k``
+  (sorting the full 152k vocab per step would dominate decode time on the VPU);
+  requests wanting a larger k degrade to MAX_TOPK, which is standard practice.
+- temperature == 0 selects greedy via ``jnp.where`` — no control flow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MAX_TOPK = 64
+
+
+def sample(
+    logits: jnp.ndarray,       # [B, V] float
+    rng: jax.Array,
+    temperature: jnp.ndarray,  # [B] float; 0 => greedy
+    top_k: jnp.ndarray,        # [B] int; 0 => disabled (use all MAX_TOPK)
+    top_p: jnp.ndarray,        # [B] float; 1.0 => disabled
+) -> jnp.ndarray:
+    """Return sampled token ids [B] (int32)."""
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    cap = min(MAX_TOPK, V)  # tiny test vocabularies can be smaller than the cap
+    vals, idxs = jax.lax.top_k(logits, cap)                 # [B, K] desc
+    k_ranks = jnp.arange(cap)[None, :]
+    eff_k = jnp.where(top_k <= 0, cap, jnp.minimum(top_k, cap))
+    vals = jnp.where(k_ranks < eff_k[:, None], vals, -jnp.inf)
+
+    # top-p (nucleus) over the candidate set: keep the smallest prefix whose
+    # probability mass reaches top_p; always keep the best candidate.
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+    probs = jax.nn.softmax(vals / safe_t, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]                    # prefix mass before me
+    keep = keep.at[:, 0].set(True)
+    vals = jnp.where(keep, vals, -jnp.inf)
+
+    draw = jax.random.categorical(rng, vals / safe_t, axis=-1)  # [B] in [0,K)
+    sampled = jnp.take_along_axis(idxs, draw[:, None], axis=1)[:, 0].astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -1,0 +1,350 @@
+"""Continuous-batching serving engine: the reference's vLLM replacement.
+
+The reference delegates this entire component to the external vLLM container
+(SURVEY.md §0 item 4, §2.2 row 1); here it is in-repo and TPU-native:
+
+- **Two compiled programs** drive everything: ``prefill_step`` (one program per
+  prompt-length bucket) and ``decode_step`` (exactly one program, all slots).
+  Static shapes throughout — XLA's compilation model is the design constraint
+  (SURVEY.md §7 hard part #2: "continuous batching under XLA's static-shape
+  constraint").
+- **Prefill/decode interleaving** with prefill priority: TTFT p50 is the headline
+  baseline metric (BASELINE.json), and a waiting prompt hurts TTFT more than one
+  decode step hurts per-token latency.
+- **Donated KV cache**: the multi-GB cache is donated to each step so XLA updates
+  it in place in HBM — no per-token copies.
+- **Per-slot sampling params as vectors**: any mix of greedy/temperature/top-p
+  requests shares the single decode program.
+
+The host-side scheduler (this file) is deliberately thin: slot bookkeeping,
+stop conditions, and streaming queues; everything hot is inside jit.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Deque, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aws_k8s_ansible_provisioner_tpu.config import ModelConfig, ServingConfig
+from aws_k8s_ansible_provisioner_tpu.models.layers import model_forward
+from aws_k8s_ansible_provisioner_tpu.ops.attention import (
+    make_decode_attend,
+    make_prefill_attend,
+)
+from aws_k8s_ansible_provisioner_tpu.ops.sampling import sample
+from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
+from aws_k8s_ansible_provisioner_tpu.serving.metrics import EngineMetrics
+
+_REQUEST_IDS = itertools.count()
+
+
+@dataclass
+class Request:
+    """One in-flight generation request."""
+
+    prompt_ids: List[int]
+    max_tokens: int = 256
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    ignore_eos: bool = False
+    stream: bool = False
+    cancelled: bool = False
+    id: int = field(default_factory=lambda: next(_REQUEST_IDS))
+    # Filled in by the engine:
+    generated: List[int] = field(default_factory=list)
+    out_queue: "queue.Queue" = field(default_factory=queue.Queue)
+    t_submit: float = 0.0
+    t_first_token: float = 0.0
+    t_done: float = 0.0
+    finish_reason: str = ""
+
+    def wait(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until completion; returns generated token ids."""
+        deadline = time.monotonic() + timeout if timeout else None
+        while True:
+            remaining = (deadline - time.monotonic()) if deadline else None
+            if remaining is not None and remaining <= 0:
+                raise TimeoutError(f"request {self.id} timed out")
+            item = self.out_queue.get(timeout=remaining)
+            if item is None:
+                return self.generated
+
+
+# ---------------------------------------------------------------------------
+# Pure jitted step functions
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
+                 temperature, top_k, top_p):
+    """Prefill one prompt into one slot; returns (cache, first sampled token).
+
+    tokens: [1, T] right-padded to a bucket; true_len: scalar valid length;
+    slot: scalar slot index.
+    """
+    T = tokens.shape[1]
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+    attend = make_prefill_attend(slot, true_len)
+    logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
+    last = jnp.take(logits[0], true_len - 1, axis=0)       # [V]
+    token = sample(last[None, :], rng, temperature[None], top_k[None],
+                   top_p[None])[0]
+    return cache, token
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def decode_step(cfg: ModelConfig, params, cache, tokens, lengths, rng,
+                temperature, top_k, top_p):
+    """One decode step for every slot. tokens/lengths/sampling params: [B]."""
+    positions = lengths[:, None]
+    attend = make_decode_attend(lengths)
+    logits, cache = model_forward(params, cfg, tokens[:, None], positions,
+                                  cache, attend)
+    nxt = sample(logits[:, 0, :], rng, temperature, top_k, top_p)
+    return cache, nxt
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+class Engine:
+    """Continuous-batching engine over a fixed set of decode slots."""
+
+    def __init__(self, cfg: ModelConfig, params, serving: ServingConfig,
+                 eos_token_id: Optional[int] = None):
+        self.cfg = cfg
+        self.params = params
+        self.serving = serving
+        self.eos_token_id = cfg.eos_token_id if eos_token_id is None \
+            else eos_token_id
+        self.num_slots = serving.max_decode_slots
+        self.max_len = serving.max_cache_len
+        self.buckets = tuple(b for b in serving.prefill_buckets
+                             if b <= self.max_len)
+        dtype = jnp.bfloat16 if serving.dtype == "bfloat16" else jnp.float32
+        self.cache = kvc.init_cache(cfg, self.num_slots, self.max_len, dtype)
+
+        self.metrics = EngineMetrics()
+        self._rng = jax.random.PRNGKey(0)
+        # Host-side slot state (numpy mirrors of the device vectors).
+        self.lengths = np.zeros(self.num_slots, np.int32)
+        self.last_token = np.zeros(self.num_slots, np.int32)
+        self.temps = np.zeros(self.num_slots, np.float32)
+        self.top_ks = np.zeros(self.num_slots, np.int32)
+        self.top_ps = np.ones(self.num_slots, np.float32)
+        self.slot_req: List[Optional[Request]] = [None] * self.num_slots
+        self.pending: Deque[Request] = collections.deque()
+        self._lock = threading.Lock()
+        self._work_event = threading.Event()
+        self._tok_times: Deque = collections.deque(maxlen=50)
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, req: Request) -> Request:
+        req.t_submit = time.monotonic()
+        # Fit prompt + generation into the slot: first bound the prompt to what a
+        # slot can hold at all, then clamp max_tokens to the remaining budget —
+        # never silently drop the prompt in favor of an oversized max_tokens.
+        prompt_limit = min(self.buckets[-1], self.max_len - 2)
+        if len(req.prompt_ids) > prompt_limit:
+            req.prompt_ids = req.prompt_ids[-prompt_limit:]  # keep the tail
+        budget = self.max_len - len(req.prompt_ids) - 1
+        if req.max_tokens > budget:
+            req.max_tokens = max(1, budget)
+        with self._lock:
+            self.pending.append(req)
+            self.metrics.queue_depth.set(len(self.pending))
+        self._work_event.set()
+        return req
+
+    def generate(self, prompt_ids: List[int], **kw) -> Request:
+        req = Request(prompt_ids=list(prompt_ids), **kw)
+        return self.submit(req)
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.slot_req) if r is None]
+
+    def _active_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.slot_req) if r is not None]
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def cancel(self, req: Request):
+        """Mark a request cancelled; its slot frees on the next engine step."""
+        req.cancelled = True
+        self._work_event.set()
+
+    def step(self) -> bool:
+        """One scheduling step: a prefill if possible, else a decode. Returns
+        whether any work was done."""
+        # reap cancelled slots first so disconnected clients free capacity
+        for slot, r in enumerate(self.slot_req):
+            if r is not None and r.cancelled:
+                r.finish_reason = "cancelled"
+                self._finish(slot)
+        with self._lock:
+            req = None
+            free = self._free_slots()
+            while self.pending and free:
+                cand = self.pending.popleft()
+                self.metrics.queue_depth.set(len(self.pending))
+                if cand.cancelled:
+                    cand.finish_reason = "cancelled"
+                    cand.out_queue.put(None)
+                    continue
+                req, slot = cand, free[0]
+                break
+        if req is not None:
+            self._do_prefill(req, slot)
+            return True
+        if self._active_slots():
+            self._do_decode()
+            return True
+        return False
+
+    def _do_prefill(self, req: Request, slot: int):
+        ids = req.prompt_ids
+        bucket = self._bucket_for(len(ids))
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :len(ids)] = ids
+        self.cache, token = prefill_step(
+            self.cfg, self.params, self.cache,
+            jnp.asarray(tokens), jnp.int32(len(ids)), jnp.int32(slot),
+            self._next_rng(), jnp.float32(req.temperature),
+            jnp.int32(req.top_k), jnp.float32(req.top_p))
+        token = int(token)
+        now = time.monotonic()
+        req.t_first_token = now
+        self.metrics.ttft.observe(now - req.t_submit)
+        self.metrics.prompt_tokens.inc(len(ids))
+
+        self.slot_req[slot] = req
+        self.lengths[slot] = len(ids)
+        self.temps[slot] = req.temperature
+        self.top_ks[slot] = req.top_k
+        self.top_ps[slot] = req.top_p
+        self.metrics.active_requests.set(len(self._active_slots()))
+        self._emit(slot, token)
+
+    def _do_decode(self):
+        t0 = time.monotonic()
+        active = self._active_slots()
+        self.cache, nxt = decode_step(
+            self.cfg, self.params, self.cache,
+            jnp.asarray(self.last_token), jnp.asarray(self.lengths),
+            self._next_rng(), jnp.asarray(self.temps),
+            jnp.asarray(self.top_ks), jnp.asarray(self.top_ps))
+        nxt = np.asarray(nxt)
+        dt = time.monotonic() - t0
+        self.metrics.decode_step_duration.observe(dt)
+        self._tok_times.append((t0, len(active)))
+        if len(self._tok_times) >= 2:
+            span = time.monotonic() - self._tok_times[0][0]
+            toks = sum(n for _, n in self._tok_times)
+            if span > 0:
+                self.metrics.tokens_per_second.set(toks / span)
+        for slot in active:
+            self.lengths[slot] += 1
+            self._emit(slot, int(nxt[slot]))
+
+    def _emit(self, slot: int, token: int):
+        """Record one generated token for a slot; handle stop conditions."""
+        req = self.slot_req[slot]
+        req.generated.append(token)
+        self.last_token[slot] = token
+        self.metrics.generated_tokens.inc()
+        if req.stream:
+            req.out_queue.put(token)
+
+        hit_eos = (token == self.eos_token_id) and not req.ignore_eos
+        out_of_budget = (len(req.generated) >= req.max_tokens
+                         or self.lengths[slot] + 1 >= self.max_len)
+        if hit_eos or out_of_budget:
+            req.finish_reason = "stop" if hit_eos else "length"
+            self._finish(slot)
+
+    def _finish(self, slot: int):
+        req = self.slot_req[slot]
+        req.t_done = time.monotonic()
+        status = ("success" if req.finish_reason in ("stop", "length")
+                  else req.finish_reason or "success")
+        self.metrics.mark_request(status, req.t_done - req.t_submit)
+        self.slot_req[slot] = None
+        self.lengths[slot] = 0
+        self.temps[slot] = 0.0
+        self.metrics.active_requests.set(len(self._active_slots()))
+        req.out_queue.put(None)  # sentinel: done
+
+    # -- loop ---------------------------------------------------------------
+
+    def run_forever(self, stop: threading.Event):
+        """Engine thread body: step until stopped, sleeping when idle.
+
+        A step failure (XLA error, OOM) must not silently kill the loop: every
+        in-flight and queued request is failed loudly (clients get their
+        sentinel instead of hanging), the error is recorded for /health, and
+        the loop keeps serving subsequent requests.
+        """
+        import logging
+
+        log = logging.getLogger(__name__)
+        while not stop.is_set():
+            try:
+                did_work = self.step()
+            except Exception as e:
+                log.exception("engine step failed; failing in-flight requests")
+                self.last_error = f"{type(e).__name__}: {e}"
+                self._fail_all(self.last_error)
+                did_work = False
+            if not did_work:
+                self._work_event.wait(timeout=0.05)
+                self._work_event.clear()
+
+    last_error: str = ""
+
+    def _fail_all(self, reason: str):
+        for slot, r in enumerate(self.slot_req):
+            if r is not None:
+                r.finish_reason = "error"
+                self._finish(slot)
+        with self._lock:
+            while self.pending:
+                r = self.pending.popleft()
+                r.finish_reason = "error"
+                self.metrics.mark_request("error", 0.0)
+                r.out_queue.put(None)
+            self.metrics.queue_depth.set(0)
+
+    def warmup(self):
+        """Pre-compile every program (each prefill bucket + decode) so the first
+        real request doesn't pay 20-40s of XLA compile time."""
+        for b in self.buckets:
+            r = Request(prompt_ids=[0] * min(b, self.max_len - 2),
+                        max_tokens=1, ignore_eos=True)
+            self.submit(r)
+            while any(s is not None for s in self.slot_req) or self.pending:
+                self.step()

@@ -1,0 +1,92 @@
+"""Decode KV cache, laid out for TPU HBM and XLA static shapes.
+
+The reference's KV cache lives inside the external vLLM container (paged attention
+over CUDA kernels; SURVEY.md §2.2 row 1). The TPU-native equivalent here uses a
+**slot-contiguous** layout: one fixed region per decode slot,
+
+    k, v : [num_layers, num_slots, max_len, num_kv_heads, head_dim]   (bf16)
+
+which is exactly a paged cache whose per-slot block table is the identity —
+``max_len/page_size`` pages per slot, page p of slot b at
+``k[:, b, p*page_size:(p+1)*page_size]``. This buys:
+
+- static shapes (XLA compiles one decode program, no re-specialization),
+- in-place row writes via scatter-at-index (donated buffers, zero copies),
+- attention that reads the cache *in place* (no gather of pages, no repeat_kv
+  materialization — see ops/attention.py),
+- a pages **view** for the Pallas ragged-attention kernel without relayout.
+
+Raggedness (every slot at a different sequence length) is expressed by a
+``lengths[num_slots]`` vector and masking, not by dynamic shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
+
+
+def init_cache(cfg: ModelConfig, num_slots: int, max_len: int,
+               dtype=jnp.bfloat16) -> dict:
+    """Allocate the decode cache. Leaves carry a leading [L] axis for lax.scan."""
+    shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+    }
+
+
+def cache_bytes(cfg: ModelConfig, num_slots: int, max_len: int,
+                dtype=jnp.bfloat16) -> int:
+    itemsize = jnp.dtype(dtype).itemsize
+    return (2 * cfg.num_layers * num_slots * max_len * cfg.num_kv_heads
+            * cfg.head_dim * itemsize)
+
+
+def write_prompt(cache_l: dict, slot: jnp.ndarray, k: jnp.ndarray,
+                 v: jnp.ndarray) -> dict:
+    """Write a prefilled prompt's K/V into one slot (single layer slice).
+
+    cache_l: {'k','v': [num_slots, max_len, Hkv, D]}; k/v: [1, T, Hkv, D];
+    slot: scalar int. Writes rows [0, T) of the slot (padded tail rows beyond the
+    true length hold garbage — decode masks by length, so they are never read).
+    """
+    k3, v3 = k[0], v[0]  # [T, Hkv, D]
+    start = (slot, jnp.zeros_like(slot), jnp.zeros_like(slot),
+             jnp.zeros_like(slot))
+    return {
+        "k": jax.lax.dynamic_update_slice(cache_l["k"], k3[None], start),
+        "v": jax.lax.dynamic_update_slice(cache_l["v"], v3[None], start),
+    }
+
+
+def write_token(cache_l: dict, lengths: jnp.ndarray, k: jnp.ndarray,
+                v: jnp.ndarray) -> dict:
+    """Scatter one new token per slot at its current length (single layer slice).
+
+    cache_l: {'k','v': [B, S, Hkv, D]}; lengths: [B]; k/v: [B, 1, Hkv, D].
+    """
+    B = k.shape[0]
+    rows = jnp.arange(B)
+    return {
+        "k": cache_l["k"].at[rows, lengths].set(k[:, 0]),
+        "v": cache_l["v"].at[rows, lengths].set(v[:, 0]),
+    }
+
+
+def pages_view(cache: dict, page_size: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Reinterpret the slot cache as pages: [L, slots*pages_per_slot, page, H, D].
+
+    Zero-copy reshape (the slot dimension is contiguous); the implied block table
+    of slot b is ``b*pages_per_slot + arange(pages_per_slot)``. Used by the Pallas
+    paged-attention kernel and by future true-paged allocation.
+    """
+    L, B, S, H, D = cache["k"].shape
+    assert S % page_size == 0, (S, page_size)
+    n = B * (S // page_size)
+    return (cache["k"].reshape(L, n, page_size, H, D),
+            cache["v"].reshape(L, n, page_size, H, D))

@@ -1,0 +1,157 @@
+"""Minimal Prometheus metrics registry (text exposition format, no deps).
+
+The scrape contract comes from the reference's observability layer: the OTEL
+collector discovers pods by annotation and scrapes ``/metrics`` on port 8000
+(``otel-observability-setup.yaml:337-391``), and its printed PromQL cookbook
+queries ``vllm_request_total``-style counters and duration histogram buckets
+(``:754-761``). We emit the same *shapes* under the ``tpu_serve_`` prefix plus
+vllm-compatible aliases so the unchanged dashboards/cookbook keep working
+(SURVEY.md §7 capability contract item 6).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, labelnames: Sequence[str] = ()):
+        self.name, self.help = name, help_
+        self.labelnames = tuple(labelnames)
+        self._values: Dict[_LabelKey, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, val in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {val}")
+        if not self._values:
+            out.append(f"{self.name} 0")
+        return out
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str):
+        self.name, self.help = name, help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float):
+        with self._lock:
+            self._value = float(v)
+
+    def add(self, v: float):
+        with self._lock:
+            self._value += v
+
+    def collect(self) -> List[str]:
+        return [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge",
+                f"{self.name} {self._value}"]
+
+
+class Histogram:
+    """Prometheus histogram with explicit buckets (for request/TTFT latency)."""
+
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                       10.0, 30.0, 60.0)
+
+    def __init__(self, name: str, help_: str,
+                 buckets: Optional[Sequence[float]] = None):
+        self.name, self.help = name, help_
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        with self._lock:
+            self._sum += v
+            self._total += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+            self._counts[-1] += 1  # +Inf
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for i, b in enumerate(self.buckets):
+            out.append(f'{self.name}_bucket{{le="{b}"}} {self._counts[i]}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self._counts[-1]}')
+        out.append(f"{self.name}_sum {self._sum}")
+        out.append(f"{self.name}_count {self._total}")
+        return out
+
+
+def _fmt_labels(key: _LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List = []
+        self._lock = threading.Lock()
+
+    def register(self, m):
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            for m in self._metrics:
+                lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+class EngineMetrics:
+    """The engine's metric set; names mirror the vLLM ones the reference scrapes."""
+
+    def __init__(self):
+        self.registry = Registry()
+        r = self.registry
+        self.request_total = r.register(Counter(
+            "tpu_serve_request_total", "Total requests", ("status",)))
+        # vllm-compatible alias so the reference's PromQL cookbook
+        # (otel-observability-setup.yaml:758-761) works unchanged.
+        self.vllm_request_total = r.register(Counter(
+            "vllm_request_total", "Total requests (vllm-compatible alias)",
+            ("status",)))
+        self.active_requests = r.register(Gauge(
+            "tpu_serve_active_requests", "Requests currently in decode slots"))
+        self.queue_depth = r.register(Gauge(
+            "tpu_serve_queue_depth", "Requests waiting for a slot"))
+        self.generated_tokens = r.register(Counter(
+            "tpu_serve_generated_tokens_total", "Generated tokens"))
+        self.prompt_tokens = r.register(Counter(
+            "tpu_serve_prompt_tokens_total", "Prompt tokens prefilled"))
+        self.request_duration = r.register(Histogram(
+            "tpu_serve_request_duration_seconds", "End-to-end request latency"))
+        self.vllm_request_duration = r.register(Histogram(
+            "vllm_request_duration_seconds",
+            "End-to-end request latency (vllm-compatible alias)"))
+        self.ttft = r.register(Histogram(
+            "tpu_serve_time_to_first_token_seconds", "Time to first token"))
+        self.decode_step_duration = r.register(Histogram(
+            "tpu_serve_decode_step_seconds", "One decode step over all slots",
+            buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5, 1., 2.5)))
+        self.tokens_per_second = r.register(Gauge(
+            "tpu_serve_tokens_per_second", "Recent decode throughput"))
+
+    def mark_request(self, status: str, duration_s: float):
+        self.request_total.inc(status=status)
+        self.vllm_request_total.inc(status=status)
+        self.request_duration.observe(duration_s)
+        self.vllm_request_duration.observe(duration_s)

@@ -74,6 +74,66 @@ class HFTokenizer:
         )
 
 
+class IncrementalDetokenizer:
+    """Streaming detokenization in O(window) per token (vLLM-style offsets).
+
+    Full-text re-decoding per streamed token is O(n^2) per request; instead keep a
+    committed prefix and only re-decode a small tail window where BPE merges /
+    multi-byte characters can still change. ``push`` returns newly-stable text
+    (may be empty); ``finish`` flushes the remainder.
+
+    Holdback rules: trailing U+FFFD is withheld (may be a partial UTF-8 char that
+    the next token completes); callers handle stop-string holdback on top.
+    """
+
+    WINDOW = 8  # tokens that may still interact with future tokens
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self._ids: list = []
+        self._committed = ""      # text for ids[:_prefix] — final, already stable
+        self._prefix = 0          # number of ids folded into _committed
+        self._emitted = 0         # chars of stable text handed to the caller
+
+    def _stable_text(self) -> str:
+        tail = self._tok.decode(self._ids[self._prefix:])
+        return self._committed + tail
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        if len(self._ids) - self._prefix > 2 * self.WINDOW:
+            # Fold the older half of the window into the committed prefix — but
+            # only at a split point that provably round-trips (splitting inside a
+            # multi-byte char or a BPE merge region would corrupt the stream).
+            end = len(self._ids)
+            whole = self._tok.decode(self._ids[self._prefix:end])
+            for cut in range(end - self.WINDOW, self._prefix, -1):
+                head = self._tok.decode(self._ids[self._prefix:cut])
+                tailtxt = self._tok.decode(self._ids[cut:end])
+                if head + tailtxt == whole:
+                    self._committed += head
+                    self._prefix = cut
+                    break
+        text = self._stable_text()
+        # hold back a possibly-incomplete char at the very end
+        while text and text[-1] == "�":
+            text = text[:-1]
+        delta = text[self._emitted:]
+        self._emitted = len(text)
+        return delta
+
+    def finish(self) -> str:
+        """Flush any held-back tail (including genuine replacement chars)."""
+        text = self._stable_text()
+        delta = text[self._emitted:]
+        self._emitted = len(text)
+        return delta
+
+    @property
+    def text(self) -> str:
+        return self._stable_text()
+
+
 def load_tokenizer(checkpoint_dir: Optional[str] = None):
     """Return the checkpoint's tokenizer if available, else the byte fallback.
 

@@ -1,0 +1,63 @@
+"""Chat template rendering parity with the reference ConfigMaps' semantics.
+
+Behavior contract from templates/phi-chat-template.yaml:1-25 and
+templates/opt-chat-template.yaml:1-25 (SURVEY.md §2.1 row 18): role prefixes,
+system-message hoisting, and the generation prompt suffix.
+"""
+
+from aws_k8s_ansible_provisioner_tpu.serving.chat_template import (
+    ChatTemplater, default_style_for_model)
+
+
+MSGS = [
+    {"role": "system", "content": "You are helpful."},
+    {"role": "user", "content": "Hi there"},
+    {"role": "assistant", "content": "Hello!"},
+    {"role": "user", "content": "Bye"},
+]
+
+
+def test_phi_style_roles_and_system_hoist():
+    t = ChatTemplater("microsoft/phi-2")
+    out = t.render(MSGS, add_generation_prompt=True)
+    assert out.startswith("You are helpful.")
+    assert "Human: Hi there" in out
+    assert "Assistant: Hello!" in out
+    assert "Human: Bye" in out
+    assert out.rstrip().endswith("Assistant:")
+    assert "User:" not in out
+
+
+def test_opt_style_roles():
+    t = ChatTemplater("Qwen/Qwen3-0.6B")
+    out = t.render(MSGS, add_generation_prompt=True)
+    assert "User: Hi there" in out
+    assert "Assistant: Hello!" in out
+    assert out.rstrip().endswith("Assistant:")
+    assert "Human:" not in out
+
+
+def test_no_generation_prompt():
+    t = ChatTemplater("Qwen/Qwen3-0.6B")
+    out = t.render(MSGS, add_generation_prompt=False)
+    assert not out.rstrip().endswith("Assistant:")
+
+
+def test_no_system_message():
+    t = ChatTemplater("microsoft/phi-2")
+    out = t.render([{"role": "user", "content": "solo"}])
+    assert out.startswith("Human: solo")
+
+
+def test_default_style_selection():
+    assert default_style_for_model("microsoft/phi-2") == "phi"
+    assert default_style_for_model("Qwen/Qwen3-0.6B") == "opt"
+
+
+def test_explicit_template_file_wins(tmp_path):
+    path = tmp_path / "tmpl.jinja"
+    path.write_text("{% for m in messages %}<{{ m.role }}>{{ m.content }}"
+                    "{% endfor %}{% if add_generation_prompt %}<go>{% endif %}")
+    t = ChatTemplater("microsoft/phi-2", template_path=str(path))
+    out = t.render([{"role": "user", "content": "x"}])
+    assert out == "<user>x<go>"

@@ -1,0 +1,46 @@
+"""Incremental detokenizer: multi-byte holdback, fold correctness, O(window)."""
+
+from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import (
+    ByteTokenizer, IncrementalDetokenizer)
+
+
+def test_multibyte_char_held_back_until_complete():
+    tok = ByteTokenizer()
+    d = IncrementalDetokenizer(tok)
+    e_acute = "é".encode()  # 2 bytes
+    assert d.push(e_acute[0]) == ""       # partial char withheld
+    assert d.push(e_acute[1]) == "é"      # completed char flushes
+
+
+def test_emoji_four_byte_sequence():
+    tok = ByteTokenizer()
+    d = IncrementalDetokenizer(tok)
+    b = "🙂".encode()  # 4 bytes
+    out = "".join(d.push(x) for x in b)
+    assert out == "🙂"
+
+
+def test_genuine_invalid_byte_eventually_flushes():
+    tok = ByteTokenizer()
+    d = IncrementalDetokenizer(tok)
+    assert d.push(0xFF) == ""             # looks like a partial char
+    assert d.push(ord("a")) == "�a"       # invalid byte resolves to U+FFFD
+    assert d.finish() == ""
+
+
+def test_long_stream_equals_batch_decode():
+    tok = ByteTokenizer()
+    text = ("Hello, 世界! " * 40) + "🙂 fin"
+    ids = tok.encode(text)
+    d = IncrementalDetokenizer(tok)
+    out = "".join(d.push(i) for i in ids) + d.finish()
+    assert out == text
+    assert d.text == text
+
+
+def test_finish_flushes_trailing_partial():
+    tok = ByteTokenizer()
+    d = IncrementalDetokenizer(tok)
+    b = "é".encode()
+    assert d.push(b[0]) == ""
+    assert d.finish() == "�"  # stream ended mid-char: surfaced, not lost

@@ -1,0 +1,56 @@
+"""KV cache layout and write-path unit tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aws_k8s_ansible_provisioner_tpu.config import tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
+
+
+def test_shapes_and_bytes():
+    cfg = tiny_qwen3()
+    cache = kvc.init_cache(cfg, num_slots=4, max_len=32, dtype=jnp.bfloat16)
+    assert cache["k"].shape == (cfg.num_layers, 4, 32, cfg.num_kv_heads,
+                                cfg.head_dim)
+    expect = 2 * np.prod(cache["k"].shape) * 2
+    assert kvc.cache_bytes(cfg, 4, 32) == expect
+
+
+def test_write_prompt_then_tokens_roundtrip():
+    cfg = tiny_qwen3()
+    cache = kvc.init_cache(cfg, 4, 32, dtype=jnp.float32)
+    layer = {"k": cache["k"][0], "v": cache["v"][0]}
+
+    rng = np.random.default_rng(0)
+    T = 5
+    k = jnp.asarray(rng.normal(size=(1, T, cfg.num_kv_heads, cfg.head_dim)),
+                    jnp.float32)
+    v = k * 2
+    layer = kvc.write_prompt(layer, jnp.int32(2), k, v)
+    np.testing.assert_allclose(np.asarray(layer["k"][2, :T]), np.asarray(k[0]))
+    np.testing.assert_allclose(np.asarray(layer["v"][2, :T]), np.asarray(v[0]))
+    # other slots untouched
+    assert float(jnp.abs(layer["k"][0]).sum()) == 0.0
+
+    # decode write at per-slot lengths
+    lengths = jnp.asarray([0, 0, T, 0], jnp.int32)
+    k1 = jnp.asarray(rng.normal(size=(4, 1, cfg.num_kv_heads, cfg.head_dim)),
+                     jnp.float32)
+    layer = kvc.write_token(layer, lengths, k1, k1 * 3)
+    np.testing.assert_allclose(np.asarray(layer["k"][2, T]), np.asarray(k1[2, 0]))
+    np.testing.assert_allclose(np.asarray(layer["v"][2, T]),
+                               np.asarray(k1[2, 0] * 3))
+    # slot 2's prompt rows survive the token write
+    np.testing.assert_allclose(np.asarray(layer["k"][2, :T]), np.asarray(k[0]))
+
+
+def test_pages_view_is_reshape():
+    cfg = tiny_qwen3()
+    cache = kvc.init_cache(cfg, 2, 32, dtype=jnp.float32)
+    cache["k"] = cache["k"].at[:, 1, 17].set(1.0)
+    kp, vp = kvc.pages_view(cache, page_size=16)
+    L = cfg.num_layers
+    assert kp.shape == (L, 2 * 2, 16, cfg.num_kv_heads, cfg.head_dim)
+    # slot 1, row 17 == page (1*2 + 1), row 1
+    assert float(kp[0, 3, 1].sum()) > 0

@@ -1,0 +1,67 @@
+"""Sampling op unit tests (greedy/temperature/top-k/top-p semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aws_k8s_ansible_provisioner_tpu.ops.sampling import MAX_TOPK, sample
+
+
+def _logits(rows):
+    return jnp.asarray(np.array(rows, np.float32))
+
+
+def test_greedy_at_zero_temperature():
+    logits = _logits([[0.1, 5.0, 0.2, 0.3], [9.0, 1.0, 2.0, 3.0]])
+    out = sample(logits, jax.random.PRNGKey(0),
+                 jnp.zeros(2), jnp.zeros(2, jnp.int32), jnp.ones(2))
+    assert out.tolist() == [1, 0]
+
+
+def test_top_k_one_is_greedy_even_with_temperature():
+    logits = _logits([[0.1, 5.0, 0.2, 0.3]])
+    for seed in range(5):
+        out = sample(logits, jax.random.PRNGKey(seed),
+                     jnp.asarray([2.0]), jnp.asarray([1], jnp.int32),
+                     jnp.ones(1))
+        assert out.tolist() == [1]
+
+
+def test_top_p_excludes_tail():
+    # One dominant token (prob ~1 under softmax): nucleus p=0.5 keeps only it.
+    logits = _logits([[20.0, 0.0, 0.0, 0.0]])
+    for seed in range(10):
+        out = sample(logits, jax.random.PRNGKey(seed),
+                     jnp.asarray([1.0]), jnp.zeros(1, jnp.int32),
+                     jnp.asarray([0.5]))
+        assert out.tolist() == [0]
+
+
+def test_sampled_tokens_respect_top_k_support():
+    rng = np.random.default_rng(0)
+    logits = _logits(rng.normal(size=(4, 100)))
+    top3 = np.argsort(-np.asarray(logits), axis=-1)[:, :3]
+    for seed in range(10):
+        out = np.asarray(sample(logits, jax.random.PRNGKey(seed),
+                                jnp.full(4, 1.5), jnp.full(4, 3, jnp.int32),
+                                jnp.ones(4)))
+        for b in range(4):
+            assert out[b] in top3[b]
+
+
+def test_mixed_batch_greedy_and_sampled():
+    logits = _logits([[0.0, 10.0, 0.0], [3.0, 3.0, 3.0]])
+    out = sample(logits, jax.random.PRNGKey(1),
+                 jnp.asarray([0.0, 1.0]), jnp.zeros(2, jnp.int32),
+                 jnp.ones(2))
+    assert int(out[0]) == 1
+    assert 0 <= int(out[1]) < 3
+
+
+def test_large_vocab_uses_candidate_cap():
+    rng = np.random.default_rng(1)
+    logits = _logits(rng.normal(size=(1, 152064)))
+    out = sample(logits, jax.random.PRNGKey(2), jnp.asarray([1.0]),
+                 jnp.zeros(1, jnp.int32), jnp.asarray([0.99]))
+    topk = set(np.argsort(-np.asarray(logits)[0])[:MAX_TOPK].tolist())
+    assert int(out[0]) in topk

@@ -1,0 +1,220 @@
+"""HTTP API tests: the reference's smoke-test contract, offline.
+
+Mirrors `llm-d-test.yaml` against an in-process server: the `/v1/models` assert
+(`llm-d-test.yaml:54-59` — THE acceptance gate) and the completion POST
+(`:61-78`), plus everything the reference never covered: chat completions with
+wired templates, streaming, /metrics shape, and error paths.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+from aws_k8s_ansible_provisioner_tpu.serving.server import (
+    ServerState, build_state, serve)
+from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+MODEL_NAME = "tiny-qwen3"
+
+
+@pytest.fixture(scope="module")
+def server():
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    serving = ServingConfig(model=MODEL_NAME, max_decode_slots=4,
+                            max_cache_len=128,
+                            prefill_buckets=(16, 32, 64), dtype="float32")
+    state = build_state(serving, model_cfg=cfg, params=params, tokenizer=tok)
+    ready, stop = threading.Event(), threading.Event()
+    t = threading.Thread(target=serve,
+                         args=(state, "127.0.0.1", 18123, ready, stop),
+                         daemon=True)
+    t.start()
+    assert ready.wait(10)
+    yield "http://127.0.0.1:18123"
+    stop.set()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(url, payload, raw=False):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        body = r.read()
+        return r.status, (body if raw else json.loads(body))
+
+
+def test_models_endpoint_lists_served_model(server):
+    status, body = _get(server + "/v1/models")
+    assert status == 200
+    # the reference's acceptance gate: model id present in the response
+    assert MODEL_NAME in json.dumps(body)
+    assert body["data"][0]["object"] == "model"
+
+
+def test_completion_roundtrip(server):
+    status, body = _post(server + "/v1/completions", {
+        "model": MODEL_NAME, "prompt": "Who are you?", "max_tokens": 8,
+    })
+    assert status == 200
+    assert body["object"] == "text_completion"
+    choice = body["choices"][0]
+    assert isinstance(choice["text"], str)
+    assert choice["finish_reason"] in ("stop", "length")
+    assert body["usage"]["prompt_tokens"] == len("Who are you?")
+    assert body["usage"]["completion_tokens"] <= 8
+
+
+def test_chat_completion_roundtrip(server):
+    status, body = _post(server + "/v1/chat/completions", {
+        "model": MODEL_NAME,
+        "messages": [{"role": "system", "content": "Be brief."},
+                     {"role": "user", "content": "Hi"}],
+        "max_tokens": 6, "temperature": 0.0,
+    })
+    assert status == 200
+    assert body["object"] == "chat.completion"
+    msg = body["choices"][0]["message"]
+    assert msg["role"] == "assistant"
+    assert isinstance(msg["content"], str)
+
+
+def test_streaming_completion(server):
+    req = urllib.request.Request(
+        server + "/v1/completions",
+        data=json.dumps({"model": MODEL_NAME, "prompt": "abc",
+                         "max_tokens": 5, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = r.read().decode()
+    events = [ln[len("data: "):] for ln in raw.splitlines()
+              if ln.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    deltas = [json.loads(e) for e in events[:-1]]
+    assert all(d["object"] == "text_completion" for d in deltas)
+    assert deltas[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_metrics_endpoint_has_scrape_shape(server):
+    with urllib.request.urlopen(server + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+        ctype = r.headers["Content-Type"]
+    assert ctype.startswith("text/plain")
+    # our metrics + the vllm-compatible aliases the OTEL cookbook queries
+    assert "tpu_serve_request_total" in text
+    assert "vllm_request_total" in text
+    assert "vllm_request_duration_seconds_bucket" in text
+    assert "tpu_serve_time_to_first_token_seconds_bucket" in text
+
+
+def test_health(server):
+    status, body = _get(server + "/health")
+    assert status == 200 and body["status"] == "ok"
+
+
+def test_unknown_model_404(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/completions",
+              {"model": "nope", "prompt": "x", "max_tokens": 1})
+    assert ei.value.code == 404
+    body = json.loads(ei.value.read())
+    assert body["error"]["type"] == "model_not_found"
+
+
+def test_bad_json_400(server):
+    req = urllib.request.Request(
+        server + "/v1/completions", data=b"{not json",
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 400
+
+
+def test_bad_max_tokens_400(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/completions",
+              {"model": MODEL_NAME, "prompt": "x", "max_tokens": 0})
+    assert ei.value.code == 400
+
+
+def test_empty_messages_400(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/chat/completions",
+              {"model": MODEL_NAME, "messages": []})
+    assert ei.value.code == 400
+
+
+def test_stop_string_truncates(server):
+    # byte tokenizer: generated text is bytes; use a stop that will appear with
+    # probability ~1 over 32 random-ish tokens? Instead force via empty stop
+    # no-op and just check the field passes through.
+    status, body = _post(server + "/v1/completions", {
+        "model": MODEL_NAME, "prompt": "hello", "max_tokens": 4,
+        "stop": ["ZZZZZZZZ"],
+    })
+    assert status == 200  # stop strings accepted; no crash when unmatched
+
+
+def test_concurrent_http_requests(server):
+    import concurrent.futures as cf
+
+    def one(i):
+        return _post(server + "/v1/completions", {
+            "model": MODEL_NAME, "prompt": f"req {i}", "max_tokens": 6})[1]
+
+    with cf.ThreadPoolExecutor(8) as ex:
+        results = list(ex.map(one, range(8)))
+    assert all(r["choices"][0]["finish_reason"] in ("stop", "length")
+               for r in results)
+
+
+def test_stream_stop_string_truncates(server):
+    # learn the deterministic (greedy) output first
+    _, full = _post(server + "/v1/completions", {
+        "model": MODEL_NAME, "prompt": "deterministic", "max_tokens": 10})
+    text = full["choices"][0]["text"]
+    if len(text) < 4:
+        pytest.skip("generation too short to carve a stop string")
+    stop = text[2:4]
+    req = urllib.request.Request(
+        server + "/v1/completions",
+        data=json.dumps({"model": MODEL_NAME, "prompt": "deterministic",
+                         "max_tokens": 10, "stream": True,
+                         "stop": [stop]}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        raw = r.read().decode()
+    events = [json.loads(ln[6:]) for ln in raw.splitlines()
+              if ln.startswith("data: ") and ln != "data: [DONE]"]
+    streamed = "".join(e["choices"][0].get("text", "") for e in events)
+    assert streamed == text[:text.find(stop)]
+    assert events[-1]["choices"][0]["finish_reason"] == "stop"
+
+
+def test_nonstream_stop_string_truncates(server):
+    _, full = _post(server + "/v1/completions", {
+        "model": MODEL_NAME, "prompt": "deterministic2", "max_tokens": 10})
+    text = full["choices"][0]["text"]
+    if len(text) < 4:
+        pytest.skip("generation too short to carve a stop string")
+    stop = text[1:3]
+    _, body = _post(server + "/v1/completions", {
+        "model": MODEL_NAME, "prompt": "deterministic2", "max_tokens": 10,
+        "stop": [stop]})
+    choice = body["choices"][0]
+    assert choice["text"] == text[:text.find(stop)]
+    assert choice["finish_reason"] == "stop"
