@@ -1,0 +1,23 @@
+"""Parallelism: device mesh, sharding rules, ring attention.
+
+The reference implements no parallelism of its own (SURVEY.md §2.3); everything
+here is net-new TPU-first design: XLA-collective backend over ICI, Megatron TP
+via PartitionSpecs, and ring attention for sequence/context parallelism.
+"""
+
+from aws_k8s_ansible_provisioner_tpu.parallel.mesh import (  # noqa: F401
+    auto_mesh_config,
+    make_mesh,
+)
+from aws_k8s_ansible_provisioner_tpu.parallel.ring_attention import (  # noqa: F401
+    make_ring_attend,
+    ring_attend_local,
+)
+from aws_k8s_ansible_provisioner_tpu.parallel.sharding import (  # noqa: F401
+    cache_pspecs,
+    check_tp_divisibility,
+    param_pspecs,
+    param_shardings,
+    shard_params,
+    tokens_pspec,
+)

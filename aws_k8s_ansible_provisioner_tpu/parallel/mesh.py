@@ -1,0 +1,66 @@
+"""Device-mesh construction for TPU slices.
+
+The reference has no distributed backend of its own (SURVEY.md §2.3: no
+NCCL/MPI/Gloo anywhere; single-GPU instance) — scaling exists only latently via
+multi-replica serving. Here the communication fabric is the TPU ICI mesh driven
+entirely by XLA collectives: we declare a logical ``Mesh`` with named axes and
+annotate shardings; the compiler emits all_gather/reduce_scatter/ppermute over
+ICI. Nothing to install, configure, or health-check — which deletes the entire
+class of comms setup the reference delegates to its external CUDA stack.
+
+Axes (see ``config.MeshConfig``):
+- ``dp``: data parallel (batch / decode slots).
+- ``tp``: tensor parallel (attention heads + MLP intermediate, Megatron layout).
+- ``sp``: sequence/context parallel (ring attention over ICI neighbors).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from aws_k8s_ansible_provisioner_tpu.config import MeshConfig
+
+
+def make_mesh(mesh_cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a (dp, tp, sp) mesh over the given (or all) devices.
+
+    Axis order puts ``tp`` and ``sp`` innermost so on a real slice they map to
+    ICI-adjacent chips (jax device order is ICI-topology-aware); ``dp`` — the
+    axis with the least communication (one gradient psum per step in training,
+    none in serving) — gets the outermost, potentially-DCN hops.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = mesh_cfg.num_devices
+    if len(devices) < n:
+        raise ValueError(
+            f"mesh {mesh_cfg} needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(mesh_cfg.dp, mesh_cfg.sp, mesh_cfg.tp)
+    # Mesh axis order is (dp, sp, tp); PartitionSpecs refer to axes by name so
+    # the tuple order only controls the device layout, not the sharding API.
+    return Mesh(arr, ("dp", "sp", "tp"))
+
+
+def auto_mesh_config(n_devices: int, want_sp: bool = True,
+                     max_tp: int = 8) -> MeshConfig:
+    """Factor a device count into a (dp, tp, sp) MeshConfig.
+
+    Preference order: use tp up to ``max_tp`` (ICI-local, cheapest collectives),
+    then sp if requested and divisible, remainder to dp. Used by
+    ``__graft_entry__.dryrun_multichip`` and by serving auto-setup.
+    """
+    tp = 1
+    rem = n_devices
+    for cand in (8, 4, 2):
+        if cand <= max_tp and rem % cand == 0:
+            tp = cand
+            rem //= cand
+            break
+    sp = 1
+    if want_sp and rem % 2 == 0:
+        sp = 2
+        rem //= 2
+    return MeshConfig(dp=rem, tp=tp, sp=sp)

@@ -1,0 +1,121 @@
+"""Sharding rules: how every parameter, activation, and cache leaf is laid out.
+
+Megatron-style tensor parallelism expressed as ``PartitionSpec``s over the
+(dp, sp, tp) mesh (parallel/mesh.py). XLA's GSPMD propagates these through the
+whole program and inserts the ICI collectives — this module is the *entire*
+distributed "backend" (SURVEY.md §2.3: the reference has none; §5: "no
+NCCL/MPI/Gloo/UCX"; the TPU equivalent is compiler-emitted collectives).
+
+Layout summary (weights are ``[in, out]``, layers stacked on a leading L axis):
+
+- attention q/k/v projections: column-parallel — heads sharded over ``tp``;
+  output projection ``wo``: row-parallel (partial sums psum'd by XLA).
+- MLP up/gate: column-parallel on the intermediate dim; down: row-parallel.
+- embedding table: vocab-sharded over ``tp`` (tied logits come out
+  vocab-sharded, exactly what the loss wants); untied ``lm_head``: vocab-
+  sharded on the output dim.
+- norms and per-head q/k norms: replicated (tiny).
+- token/position arrays: batch over ``dp``, sequence over ``sp``.
+- decode KV cache ``[L, slots, S, Hkv, D]``: kv heads over ``tp``, slots over
+  ``dp`` (each data-parallel group owns its slots).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
+
+
+def check_tp_divisibility(cfg: ModelConfig, tp: int) -> None:
+    """TP must evenly split query heads, kv heads, and the MLP intermediate."""
+    for name, dim in (("num_heads", cfg.num_heads),
+                      ("num_kv_heads", cfg.num_kv_heads),
+                      ("intermediate_size", cfg.intermediate_size),
+                      ("vocab_size", cfg.vocab_size)):
+        if dim % tp != 0:
+            raise ValueError(f"tp={tp} does not divide {name}={dim} "
+                             f"for model {cfg.name}")
+
+
+def _layer_pspecs(cfg: ModelConfig) -> dict:
+    """PartitionSpecs mirroring models/layers.init_layer_params structure."""
+
+    def col(bias: bool) -> dict:  # [L, in, out] — shard out
+        p = {"kernel": P(None, None, "tp")}
+        if bias:
+            p["bias"] = P(None, "tp")
+        return p
+
+    def row(bias: bool) -> dict:  # [L, in, out] — shard in, replicate out
+        p = {"kernel": P(None, "tp", None)}
+        if bias:
+            p["bias"] = P(None, None)
+        return p
+
+    def norm() -> dict:
+        p = {"weight": P(None, None)}
+        if cfg.norm == "layernorm":
+            p["bias"] = P(None, None)
+        return p
+
+    specs = {
+        "input_norm": norm(),
+        "wq": col(cfg.attention_bias),
+        "wk": col(cfg.attention_bias),
+        "wv": col(cfg.attention_bias),
+        "wo": row(cfg.attention_bias),
+    }
+    if cfg.qk_norm:
+        specs["q_norm"] = {"weight": P(None, None)}
+        specs["k_norm"] = {"weight": P(None, None)}
+    if cfg.act == "silu":
+        specs["w_gate"] = col(cfg.mlp_bias)
+    specs["w_up"] = col(cfg.mlp_bias)
+    specs["w_down"] = row(cfg.mlp_bias)
+    if not cfg.parallel_block:
+        specs["post_norm"] = norm()
+    return specs
+
+
+def param_pspecs(cfg: ModelConfig) -> dict:
+    """Full-parameter PartitionSpec pytree (same structure as init_params)."""
+    specs: dict = {
+        "embed": {"weight": P("tp", None)},  # vocab-sharded
+        "layers": _layer_pspecs(cfg),
+        "final_norm": {"weight": P(None)},
+    }
+    if cfg.norm == "layernorm":
+        specs["final_norm"]["bias"] = P(None)
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = {"kernel": P(None, "tp")}
+        if cfg.parallel_block:
+            specs["lm_head"]["bias"] = P("tp")
+    return specs
+
+
+def cache_pspecs() -> dict:
+    """Decode cache [L, slots, S, Hkv, D]: slots over dp, kv heads over tp."""
+    return {
+        "k": P(None, "dp", None, "tp", None),
+        "v": P(None, "dp", None, "tp", None),
+    }
+
+
+def tokens_pspec(seq_sharded: bool = False) -> P:
+    """[B, T] activations: batch over dp, optionally sequence over sp."""
+    return P("dp", "sp" if seq_sharded else None)
+
+
+def param_shardings(mesh: Mesh, cfg: ModelConfig) -> Any:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs(cfg),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params: Any, mesh: Mesh, cfg: ModelConfig) -> Any:
+    """Place an (unsharded or host) param pytree onto the mesh per the rules."""
+    shardings = param_shardings(mesh, cfg)
+    return jax.tree.map(jax.device_put, params, shardings)

@@ -1,0 +1,6 @@
+from aws_k8s_ansible_provisioner_tpu.training.trainer import (  # noqa: F401
+    TrainState,
+    lm_loss,
+    make_train_step,
+    init_train_state,
+)

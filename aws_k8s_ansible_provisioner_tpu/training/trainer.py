@@ -1,0 +1,128 @@
+"""Sharded training step: LM loss + AdamW over the (dp, tp, sp) mesh.
+
+The reference serves a pretrained model and never trains (SURVEY.md §5
+"Checkpoint/resume (models): none in-repo"); this module exists because a
+complete TPU framework must also *produce* models, and because the distributed
+design (sharding rules in parallel/sharding.py) is exercised hardest by the
+backward pass: GSPMD inserts the tp psums for row-parallel matmul grads and the
+dp gradient all-reduce automatically from the same PartitionSpecs the serving
+path uses — one sharding source of truth for train and serve.
+
+TPU-first choices:
+- loss in float32 with a vocab-sharded logit layout (embedding table is sharded
+  over tp on the vocab dim, so tied-embedding logits come out vocab-sharded and
+  the cross-entropy reductions ride a single small psum).
+- optional ring attention (sp axis) for long-context training.
+- `jax.checkpoint` (remat) over the layer scan body — HBM for FLOPs.
+- donated state: params/opt state update in place in HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params, model_forward
+from aws_k8s_ansible_provisioner_tpu.parallel import (
+    make_ring_attend,
+    param_pspecs,
+    param_shardings,
+    tokens_pspec,
+)
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=("params", "opt_state", "step"), meta_fields=())
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def lm_loss(params, cfg: ModelConfig, tokens: jnp.ndarray,
+            loss_mask: jnp.ndarray, attend=None, remat: bool = True):
+    """Next-token cross entropy. tokens: [B, T]; loss_mask: [B, T] (1 = predict
+    the token at this position from the prefix before it; position 0 ignored).
+    """
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    logits, _ = model_forward(params, cfg, tokens, positions, None,
+                              attend=attend, remat=remat)
+    logits = logits.astype(jnp.float32)
+    # predict token t+1 from position t
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    mask = loss_mask[:, 1:].astype(jnp.float32)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def init_train_state(cfg: ModelConfig, mesh: Mesh, optimizer,
+                     seed: int = 0, dtype=jnp.float32) -> TrainState:
+    """Initialize params + optimizer state directly sharded over the mesh.
+
+    Uses jit-with-out_shardings so the big arrays are *born* sharded on device
+    (no host-side full copy — matters for 8B-scale models).
+    """
+    pspecs = param_pspecs(cfg)
+    shardings = param_shardings(mesh, cfg)
+
+    init_fn = jax.jit(lambda key: init_params(cfg, key, dtype),
+                      out_shardings=shardings)
+    params = init_fn(jax.random.PRNGKey(seed))
+
+    opt_pspecs = _opt_state_pspecs(optimizer, params, pspecs)
+    opt_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), opt_pspecs,
+        is_leaf=lambda x: isinstance(x, P))
+    opt_init = jax.jit(optimizer.init, out_shardings=opt_shardings)
+    opt_state = opt_init(params)
+    return TrainState(params=params, opt_state=opt_state,
+                      step=jnp.zeros((), jnp.int32))
+
+
+def _opt_state_pspecs(optimizer, params, pspecs):
+    """Optimizer-state PartitionSpecs: moments shard like their params, scalars
+    replicate. Derived structurally from an eval_shape of optimizer.init."""
+    shapes = jax.eval_shape(optimizer.init, params)
+    flat_p, _ = jax.tree.flatten(params)
+    flat_s, _ = jax.tree.flatten(pspecs, is_leaf=lambda x: isinstance(x, P))
+    by_shape = {}
+    for p, s in zip(flat_p, flat_s):
+        by_shape.setdefault((p.shape, p.dtype), s)
+
+    def spec_for(leaf):
+        return by_shape.get((leaf.shape, leaf.dtype), P())
+
+    return jax.tree.map(spec_for, shapes)
+
+
+def make_train_step(cfg: ModelConfig, mesh: Mesh, optimizer,
+                    seq_parallel: bool = False,
+                    remat: bool = True) -> Callable:
+    """Build the jitted train step: (state, tokens, loss_mask) -> (state, loss).
+
+    Data sharding: batch over dp, sequence over sp (when seq_parallel, attention
+    runs as ring attention over the sp axis; otherwise sequence is replicated).
+    Donates the state so params/opt buffers update in place in HBM.
+    """
+    attend = make_ring_attend(mesh) if seq_parallel else None
+    data_sharding = NamedSharding(mesh, tokens_pspec(seq_sharded=seq_parallel))
+
+    def step(state: TrainState, tokens, loss_mask) -> Tuple[TrainState, jnp.ndarray]:
+        loss, grads = jax.value_and_grad(lm_loss)(
+            state.params, cfg, tokens, loss_mask, attend, remat)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(params=new_params, opt_state=new_opt,
+                          step=state.step + 1), loss
+
+    return jax.jit(step, donate_argnums=(0,),
+                   in_shardings=(None, data_sharding, data_sharding))
