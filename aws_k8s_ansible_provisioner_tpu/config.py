@@ -253,6 +253,9 @@ class ServingConfig:
     prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024, 2048)
     # Max tokens of KV cache per slot (static decode shape).
     max_cache_len: int = 2048
+    # Fused decode horizon: tokens generated per device dispatch when no
+    # prefill is waiting (amortizes dispatch latency; see engine.decode_steps).
+    decode_horizon: int = 8
     # Paged KV cache geometry.
     page_size: int = 64
     max_tokens_default: int = 256

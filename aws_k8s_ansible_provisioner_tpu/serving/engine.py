@@ -104,16 +104,35 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
     return cache, token
 
 
-@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def decode_step(cfg: ModelConfig, params, cache, tokens, lengths, rng,
-                temperature, top_k, top_p):
-    """One decode step for every slot. tokens/lengths/sampling params: [B]."""
-    positions = lengths[:, None]
-    attend = make_decode_attend(lengths)
-    logits, cache = model_forward(params, cfg, tokens[:, None], positions,
-                                  cache, attend)
-    nxt = sample(logits[:, 0, :], rng, temperature, top_k, top_p)
-    return cache, nxt
+@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
+def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
+                 lengths, rng, temperature, top_k, top_p):
+    """``n_steps`` fused decode steps for every slot, one device dispatch.
+
+    tokens/lengths/sampling params: [B]. Returns (cache, out [n_steps, B]).
+
+    Fusing the token loop into one ``lax.scan`` is a TPU-first scheduling
+    decision: per-dispatch host→device latency (worst over a network-attached
+    chip) is paid once per *horizon* instead of once per token, and XLA keeps
+    the KV cache resident in HBM across all substeps (donated carry). The
+    scheduler only uses a horizon > 1 when no prefill is waiting, so TTFT is
+    not taxed. Slots that hit a stop condition mid-horizon generate a few
+    surplus tokens which the host discards; writes past ``max_len`` are
+    dropped by XLA's out-of-bounds scatter semantics (never corrupt memory).
+    """
+
+    def body(carry, rng_i):
+        cache, tok, lens = carry
+        positions = lens[:, None]
+        attend = make_decode_attend(lens)
+        logits, cache = model_forward(params, cfg, tok[:, None], positions,
+                                      cache, attend)
+        nxt = sample(logits[:, 0, :], rng_i, temperature, top_k, top_p)
+        return (cache, nxt, lens + 1), nxt
+
+    rngs = jax.random.split(rng, n_steps)
+    (cache, _, _), out = jax.lax.scan(body, (cache, tokens, lengths), rngs)
+    return cache, out
 
 
 # ---------------------------------------------------------------------------
@@ -253,23 +272,32 @@ class Engine:
     def _do_decode(self):
         t0 = time.monotonic()
         active = self._active_slots()
-        self.cache, nxt = decode_step(
-            self.cfg, self.params, self.cache,
+        # Fused horizon only when no prompt is waiting (keeps TTFT unharmed);
+        # single step otherwise so a new request prefills at the next step.
+        with self._lock:
+            horizon = 1 if self.pending else max(1, self.serving.decode_horizon)
+        self.cache, out = decode_steps(
+            self.cfg, horizon, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
             self._next_rng(), jnp.asarray(self.temps),
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps))
-        nxt = np.asarray(nxt)
+        out = np.asarray(out)  # [horizon, B]
         dt = time.monotonic() - t0
-        self.metrics.decode_step_duration.observe(dt)
-        self._tok_times.append((t0, len(active)))
+        self.metrics.decode_step_duration.observe(dt / horizon)
+        emitted = 0
+        for s in range(horizon):
+            for slot in active:
+                if self.slot_req[slot] is None:
+                    continue  # finished earlier in this horizon
+                self.lengths[slot] += 1
+                self._emit(slot, int(out[s, slot]))
+                emitted += 1
+        self._tok_times.append((t0, emitted))
         if len(self._tok_times) >= 2:
             span = time.monotonic() - self._tok_times[0][0]
             toks = sum(n for _, n in self._tok_times)
             if span > 0:
                 self.metrics.tokens_per_second.set(toks / span)
-        for slot in active:
-            self.lengths[slot] += 1
-            self._emit(slot, int(nxt[slot]))
 
     def _emit(self, slot: int, token: int):
         """Record one generated token for a slot; handle stop conditions."""
@@ -348,3 +376,9 @@ class Engine:
             self.submit(r)
             while any(s is not None for s in self.slot_req) or self.pending:
                 self.step()
+        # compile the fused decode program too (horizon path)
+        r = Request(prompt_ids=[0] * 4,
+                    max_tokens=self.serving.decode_horizon + 1, ignore_eos=True)
+        self.submit(r)
+        while any(s is not None for s in self.slot_req) or self.pending:
+            self.step()
