@@ -3,9 +3,10 @@
 The reference delegates this entire component to the external vLLM container
 (SURVEY.md §0 item 4, §2.2 row 1); here it is in-repo and TPU-native:
 
-- **Two compiled programs** drive everything: ``prefill_step`` (one program per
-  prompt-length bucket) and ``decode_step`` (exactly one program, all slots).
-  Static shapes throughout — XLA's compilation model is the design constraint
+- **A small fixed set of compiled programs** drives everything:
+  ``prefill_step`` (one program per prompt-length bucket) and ``decode_steps``
+  (two programs over all slots: fused horizon=N when no prompt waits,
+  horizon=1 otherwise — ``n_steps`` is static). Static shapes throughout — XLA's compilation model is the design constraint
   (SURVEY.md §7 hard part #2: "continuous batching under XLA's static-shape
   constraint").
 - **Prefill/decode interleaving** with prefill priority: TTFT p50 is the headline
@@ -272,10 +273,14 @@ class Engine:
     def _do_decode(self):
         t0 = time.monotonic()
         active = self._active_slots()
-        # Fused horizon only when no prompt is waiting (keeps TTFT unharmed);
-        # single step otherwise so a new request prefills at the next step.
+        # Fused horizon unless a waiting prompt could actually prefill next
+        # step (pending AND a free slot): then take a single step so TTFT
+        # isn't taxed. Under saturation (pending but no free slot) a prefill
+        # is impossible anyway, so keep the fused horizon — dropping to
+        # horizon=1 there would disable the amortization exactly at peak load.
         with self._lock:
-            horizon = 1 if self.pending else max(1, self.serving.decode_horizon)
+            prefill_possible = bool(self.pending) and bool(self._free_slots())
+        horizon = 1 if prefill_possible else max(1, self.serving.decode_horizon)
         self.cache, out = decode_steps(
             self.cfg, horizon, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
@@ -377,8 +382,21 @@ class Engine:
             while any(s is not None for s in self.slot_req) or self.pending:
                 self.step()
         # compile the fused decode program too (horizon path)
-        r = Request(prompt_ids=[0] * 4,
-                    max_tokens=self.serving.decode_horizon + 1, ignore_eos=True)
-        self.submit(r)
-        while any(s is not None for s in self.slot_req) or self.pending:
-            self.step()
+        horizon = max(1, self.serving.decode_horizon)
+        if horizon > 1:
+            r = Request(prompt_ids=[0] * 4, max_tokens=horizon + 1,
+                        ignore_eos=True)
+            self.submit(r)
+            while any(s is not None for s in self.slot_req) or self.pending:
+                self.step()
+        # The horizon=1 decode variant (selected whenever a prefill is
+        # possible) is a distinct compiled program (n_steps is static);
+        # compile it now so the first decode overlapping a queued request
+        # doesn't stall all in-flight streams on XLA. Direct call, no slot
+        # state touched: writes land at position 0 of idle slots and are
+        # overwritten by real prefills.
+        self.cache, _ = decode_steps(
+            self.cfg, 1, self.params, self.cache,
+            jnp.asarray(self.last_token), jnp.asarray(self.lengths),
+            self._next_rng(), jnp.asarray(self.temps),
+            jnp.asarray(self.top_ks), jnp.asarray(self.top_ps))

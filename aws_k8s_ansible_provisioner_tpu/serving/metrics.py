@@ -29,6 +29,11 @@ class Counter:
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
+    def total(self) -> float:
+        """Sum over all label combinations (bench/test introspection)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         for key, val in sorted(self._values.items()):
@@ -145,7 +150,8 @@ class EngineMetrics:
         self.ttft = r.register(Histogram(
             "tpu_serve_time_to_first_token_seconds", "Time to first token"))
         self.decode_step_duration = r.register(Histogram(
-            "tpu_serve_decode_step_seconds", "One decode step over all slots",
+            "tpu_serve_decode_step_seconds",
+            "Per-token decode latency over all slots (dispatch time / horizon)",
             buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5, 1., 2.5)))
         self.tokens_per_second = r.register(Gauge(
             "tpu_serve_tokens_per_second", "Recent decode throughput"))

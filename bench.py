@@ -38,6 +38,10 @@ def main() -> None:
         max_decode_slots=32 if on_tpu else 4,
         max_cache_len=1024 if on_tpu else 128,
         prefill_buckets=(32,),
+        # Large fused horizon amortizes host->device dispatch (the chip is
+        # network-attached under the bench harness); serving keeps the smaller
+        # default so streaming latency stays bounded.
+        decode_horizon=32 if on_tpu else 4,
     )
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.bfloat16)
     engine = Engine(cfg, params, serving)
@@ -54,9 +58,15 @@ def main() -> None:
     for _ in range(3):
         engine.step()
 
-    # Timed decode window.
-    target_steps = 200 if on_tpu else 10
+    # Timed decode window. Each step emits up to decode_horizon tokens per
+    # slot, so size the window within the per-slot budget (all slots stay
+    # active throughout) and count ACTUAL emitted tokens via the metrics
+    # counter, not steps * slots.
+    horizon = max(1, serving.decode_horizon)
+    target_steps = min(100, (gen_budget - 8 * horizon) // horizon) if on_tpu \
+        else 4
     jax.block_until_ready(engine.cache["k"])
+    toks0 = engine.metrics.generated_tokens.total()
     t0 = time.monotonic()
     steps = 0
     while steps < target_steps:
@@ -64,8 +74,8 @@ def main() -> None:
         steps += 1
     jax.block_until_ready(engine.cache["k"])
     dt = time.monotonic() - t0
-
-    toks = steps * n_slots
+    toks = engine.metrics.generated_tokens.total() - toks0
+    assert toks > 0, "no tokens generated in timed window"
     tps = toks / dt
     print(json.dumps({
         "metric": f"qwen3-0.6b decode tokens/sec/chip (batch={n_slots}, {platform})",
