@@ -298,6 +298,9 @@ class DeployConfig:
     # engine is the authority); ansible_vars() merges them in — no second copy here.
     serving_namespace: str = "tpu-serve"
     gateway_name: str = "tpu-inference-gateway"
+    # Container image carrying this framework (engine + k8s runtime components).
+    framework_image: str = "ghcr.io/tpu-serve/aws-k8s-ansible-provisioner-tpu:latest"
+    serving_replicas: int = 1
     storage_class: str = "local-path"
     model_storage_gi: int = 100
     # Observability.

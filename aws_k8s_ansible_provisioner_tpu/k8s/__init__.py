@@ -1,0 +1,17 @@
+"""Kubernetes node-runtime components of the TPU framework.
+
+The reference delegated node enablement to the NVIDIA GPU Operator
+(reference kubernetes-single-node.yaml:321-348): driver, device plugin
+(`nvidia.com/gpu`), and DCGM telemetry. TPU VMs need no driver install, so the
+TPU-native equivalents are exactly two small services, both in this package:
+
+- ``device_plugin``: kubelet device-plugin (v1beta1 gRPC over the kubelet's
+  unix socket) advertising ``google.com/tpu`` from the node's /dev/accel* or
+  /dev/vfio device nodes.
+- ``metrics_exporter``: Prometheus exporter for per-chip TPU telemetry (HBM
+  usage, duty cycle, core counts) on the named port ``tpu-metrics`` — the
+  scrape-shape stand-in for the DCGM exporter (reference
+  kubernetes-single-node.yaml:480-504, otel-observability-setup.yaml:393-468).
+  A native C++ implementation lives in ``native/metrics_exporter``; this
+  package's Python module is the deployment default and fallback.
+"""

@@ -1,0 +1,186 @@
+"""TPU kubelet device plugin: advertises ``google.com/tpu`` to Kubernetes.
+
+TPU-native replacement for the NVIDIA GPU Operator's device plugin (the
+keystone the reference installs at kubernetes-single-node.yaml:338-348 to get
+the ``nvidia.com/gpu`` resource). TPU VMs need no driver or toolkit install,
+so the whole operator collapses to this one service:
+
+1. discover TPU chips from the node's device tree (``/dev/accel*`` for the
+   TPU-VM runtime, ``/dev/vfio/*`` for the VFIO path);
+2. serve the kubelet device-plugin v1beta1 gRPC API (GetDevicePluginOptions,
+   ListAndWatch, Allocate, ...) on our own unix socket under
+   ``/var/lib/kubelet/device-plugins/``;
+3. register with the kubelet's ``kubelet.sock`` Registration service;
+4. on kubelet restart (our socket is deleted), re-register — the standard
+   device-plugin lifecycle.
+
+Messages are hand-encoded protobuf (see ``protowire``) served through grpc's
+raw-bytes (de)serializers, so no codegen toolchain is needed at build time.
+
+Allocate responses mount the requested /dev nodes into the container and set
+``TPU_VISIBLE_CHIPS`` (honored by libtpu) so a pod that requests fewer than
+all chips sees only its own — the TPU analogue of CUDA_VISIBLE_DEVICES.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+
+from aws_k8s_ansible_provisioner_tpu.k8s import protowire as pw
+
+log = logging.getLogger("tpu_serve.device_plugin")
+
+RESOURCE_NAME = "google.com/tpu"
+API_VERSION = "v1beta1"
+KUBELET_DIR = "/var/lib/kubelet/device-plugins"
+PLUGIN_SOCKET = "tpu-device-plugin.sock"
+
+
+def _chip_index(device_path: str) -> str:
+    """Map a device node to its chip index: /dev/accel3 → "3", /dev/vfio/7 → "7"."""
+    name = device_path.rsplit("/", 1)[-1]
+    digits = "".join(ch for ch in name if ch.isdigit())
+    return digits or "0"
+
+
+def discover_tpu_devices() -> list[str]:
+    """Enumerate TPU chip device nodes on this host.
+
+    TPU-VM runtime exposes one ``/dev/accel<N>`` per chip; the VFIO path
+    exposes ``/dev/vfio/<group>``. The reference's analogue was the GPU
+    operator reading NVML; here a directory listing suffices.
+    """
+    accel = sorted(glob.glob("/dev/accel*"))
+    if accel:
+        return accel
+    vfio = sorted(p for p in glob.glob("/dev/vfio/*") if p.rsplit("/", 1)[-1].isdigit())
+    return vfio
+
+
+class DevicePluginServicer:
+    """v1beta1.DevicePlugin service over hand-rolled protobuf bytes."""
+
+    def __init__(self, devices: list[str], poll_s: float = 5.0):
+        self.devices = devices
+        self.poll_s = poll_s
+
+    # /v1beta1.DevicePlugin/GetDevicePluginOptions
+    def get_device_plugin_options(self, request: bytes, context) -> bytes:
+        return pw.device_plugin_options()
+
+    # /v1beta1.DevicePlugin/ListAndWatch  (server-streaming)
+    def list_and_watch(self, request: bytes, context):
+        last: list[str] | None = None
+        while True:
+            current = discover_tpu_devices() or self.devices
+            if current != last:
+                log.info("advertising %d TPU device(s): %s", len(current), current)
+                yield pw.list_and_watch_response(current)
+                last = current
+            time.sleep(self.poll_s)
+
+    # /v1beta1.DevicePlugin/Allocate
+    def allocate(self, request: bytes, context) -> bytes:
+        responses = []
+        for ids in pw.parse_allocate_request(request):
+            # Chip indices must come from the ACTUAL allocated device nodes
+            # (/dev/accel3 → chip 3), not renumbered from 0 — otherwise two
+            # pods sharing a host would both be pointed at chips 0..n-1.
+            chips = ",".join(_chip_index(d) for d in ids)
+            envs = {
+                "TPU_VISIBLE_CHIPS": chips,
+                "TPU_CHIPS_PER_PROCESS_BOUNDS": f"1,{max(len(ids), 1)},1",
+            }
+            responses.append(pw.container_allocate_response(envs, ids))
+            log.info("allocate: %s -> TPU_VISIBLE_CHIPS=%s", ids, chips)
+        return pw.allocate_response(responses)
+
+    # /v1beta1.DevicePlugin/GetPreferredAllocation, /PreStartContainer
+    def empty(self, request: bytes, context) -> bytes:
+        return b""
+
+
+def build_server(servicer: DevicePluginServicer, address: str):
+    import grpc
+
+    ident = lambda b: b  # noqa: E731 — raw bytes in/out, protowire does framing
+    handlers = {
+        "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+            servicer.get_device_plugin_options, ident, ident),
+        "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+            servicer.list_and_watch, ident, ident),
+        "Allocate": grpc.unary_unary_rpc_method_handler(
+            servicer.allocate, ident, ident),
+        "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+            servicer.empty, ident, ident),
+        "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+            servicer.empty, ident, ident),
+    }
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(f"{API_VERSION}.DevicePlugin", handlers),))
+    server.add_insecure_port(address)
+    return server
+
+
+def register_with_kubelet(kubelet_sock: str, endpoint: str):
+    import grpc
+
+    channel = grpc.insecure_channel(f"unix://{kubelet_sock}")
+    register = channel.unary_unary(
+        f"/{API_VERSION}.Registration/Register",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    register(pw.register_request(API_VERSION, endpoint, RESOURCE_NAME))
+    channel.close()
+    log.info("registered %s with kubelet (endpoint %s)", RESOURCE_NAME, endpoint)
+
+
+def run(kubelet_dir: str = KUBELET_DIR, once: bool = False):
+    devices = discover_tpu_devices()
+    if not devices:
+        log.warning("no TPU device nodes found; advertising zero capacity")
+    sock_path = os.path.join(kubelet_dir, PLUGIN_SOCKET)
+    kubelet_sock = os.path.join(kubelet_dir, "kubelet.sock")
+
+    while True:
+        if os.path.exists(sock_path):
+            os.unlink(sock_path)
+        servicer = DevicePluginServicer(devices)
+        server = build_server(servicer, f"unix://{sock_path}")
+        server.start()
+        try:
+            register_with_kubelet(kubelet_sock, PLUGIN_SOCKET)
+        except Exception as e:  # kubelet not up yet — retry loop below
+            log.warning("kubelet registration failed: %s", e)
+        if once:
+            server.stop(0)
+            return
+        # Watch for kubelet restarts: kubelet wipes its plugin dir on restart,
+        # deleting our socket — the signal to re-serve and re-register.
+        while os.path.exists(sock_path):
+            time.sleep(5)
+        log.info("kubelet restart detected (socket removed); re-registering")
+        server.stop(0)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    p = argparse.ArgumentParser(description="TPU kubelet device plugin")
+    p.add_argument("--kubelet-dir", default=KUBELET_DIR)
+    p.add_argument("--once", action="store_true",
+                   help="serve+register once and exit (for tests)")
+    args = p.parse_args(argv)
+    run(args.kubelet_dir, once=args.once)
+
+
+if __name__ == "__main__":
+    main()

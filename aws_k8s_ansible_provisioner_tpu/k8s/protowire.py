@@ -1,0 +1,145 @@
+"""Minimal protobuf wire-format encode/decode for the kubelet device-plugin API.
+
+The kubelet device-plugin protocol (k8s.io/kubelet/pkg/apis/deviceplugin/v1beta1)
+is a tiny gRPC surface whose messages use only three wire types: varint (bool),
+and length-delimited (string, embedded message, map entry). Rather than depend
+on grpcio-tools codegen (not in the base image), we hand-encode the handful of
+messages on the wire. grpc's Python runtime accepts raw-bytes serializers, so
+this module plus ``grpc`` is a complete client+server stack.
+
+Wire format rules used (protobuf encoding spec, public):
+- field key = (field_number << 3) | wire_type; wire_type 0 = varint,
+  2 = length-delimited.
+- strings/messages/maps are length-delimited: key, varint length, payload.
+- map<string,string> encodes as a repeated embedded message with key=field 1,
+  value=field 2.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(field: int, wire_type: int) -> bytes:
+    return _varint((field << 3) | wire_type)
+
+
+def encode_string(field: int, value: str) -> bytes:
+    raw = value.encode()
+    return tag(field, 2) + _varint(len(raw)) + raw
+
+
+def encode_message(field: int, payload: bytes) -> bytes:
+    return tag(field, 2) + _varint(len(payload)) + payload
+
+
+def encode_bool(field: int, value: bool) -> bytes:
+    return tag(field, 0) + _varint(1 if value else 0)
+
+
+def encode_map_entry(field: int, key: str, value: str) -> bytes:
+    entry = encode_string(1, key) + encode_string(2, value)
+    return encode_message(field, entry)
+
+
+def decode_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def iter_fields(buf: bytes) -> Iterator[Tuple[int, int, bytes | int]]:
+    """Yield (field_number, wire_type, value) over a serialized message.
+
+    Length-delimited values come back as bytes; varints as int. Groups and
+    fixed32/64 are not used by the device-plugin API and raise.
+    """
+    pos = 0
+    while pos < len(buf):
+        key, pos = decode_varint(buf, pos)
+        field, wt = key >> 3, key & 0x7
+        if wt == 0:
+            val, pos = decode_varint(buf, pos)
+            yield field, wt, val
+        elif wt == 2:
+            ln, pos = decode_varint(buf, pos)
+            yield field, wt, buf[pos:pos + ln]
+            pos += ln
+        else:
+            raise ValueError(f"unsupported wire type {wt} for field {field}")
+
+
+# ---------------------------------------------------------------------------
+# Device-plugin v1beta1 messages (field numbers from the public api.proto)
+# ---------------------------------------------------------------------------
+
+
+def register_request(version: str, endpoint: str, resource_name: str) -> bytes:
+    """RegisterRequest{version=1, endpoint=2, resource_name=3}."""
+    return (encode_string(1, version)
+            + encode_string(2, endpoint)
+            + encode_string(3, resource_name))
+
+
+def device_plugin_options(pre_start_required: bool = False,
+                          get_preferred_allocation_available: bool = False) -> bytes:
+    """DevicePluginOptions{pre_start_required=1, get_preferred_allocation_available=2}."""
+    return (encode_bool(1, pre_start_required)
+            + encode_bool(2, get_preferred_allocation_available))
+
+
+def device(dev_id: str, health: str = "Healthy") -> bytes:
+    """Device{ID=1, health=2} (topology hints omitted — single-node TPU VM)."""
+    return encode_string(1, dev_id) + encode_string(2, health)
+
+
+def list_and_watch_response(device_ids: List[str], health: str = "Healthy") -> bytes:
+    """ListAndWatchResponse{devices=1 repeated Device}."""
+    return b"".join(encode_message(1, device(d, health)) for d in device_ids)
+
+
+def parse_allocate_request(buf: bytes) -> List[List[str]]:
+    """AllocateRequest{container_requests=1 repeated {devices_ids=1 repeated string}}."""
+    containers: List[List[str]] = []
+    for field, wt, val in iter_fields(buf):
+        if field == 1 and wt == 2:
+            ids = [v.decode() for f, w, v in iter_fields(val) if f == 1 and w == 2]
+            containers.append(ids)
+    return containers
+
+
+def device_spec(container_path: str, host_path: str, permissions: str = "rw") -> bytes:
+    """DeviceSpec{container_path=1, host_path=2, permissions=3}."""
+    return (encode_string(1, container_path)
+            + encode_string(2, host_path)
+            + encode_string(3, permissions))
+
+
+def container_allocate_response(envs: Dict[str, str],
+                                device_paths: List[str]) -> bytes:
+    """ContainerAllocateResponse{envs=1 map, devices=3 repeated DeviceSpec}."""
+    out = b"".join(encode_map_entry(1, k, v) for k, v in envs.items())
+    out += b"".join(encode_message(3, device_spec(p, p)) for p in device_paths)
+    return out
+
+
+def allocate_response(per_container: List[bytes]) -> bytes:
+    """AllocateResponse{container_responses=1 repeated ContainerAllocateResponse}."""
+    return b"".join(encode_message(1, c) for c in per_container)

@@ -203,3 +203,37 @@ def config_from_hf_dir(checkpoint_dir: str) -> ModelConfig:
             hf_repo=name,
         )
     raise ValueError(f"unsupported model_type {model_type!r} in {checkpoint_dir}")
+
+
+def download_snapshot(model: str, dest: str) -> str:
+    """Download a model's safetensors snapshot from HF Hub into ``dest``.
+
+    CLI mode used by the deploy layer's model-download Job (deploy/manifests/
+    serving.yaml.j2), the in-repo replacement for the reference's
+    ``llmd-installer.sh --download-model`` (reference llm-d-deploy.yaml:184).
+    Auth comes from the HF_TOKEN env var, injected from a K8s Secret — never a
+    command-line argument (fixes the exposure at reference llm-d-deploy.yaml:178).
+    """
+    import os
+    from huggingface_hub import snapshot_download
+
+    target = os.path.join(dest, model)
+    os.makedirs(target, exist_ok=True)
+    path = snapshot_download(
+        repo_id=model,
+        local_dir=target,
+        token=os.environ.get("HF_TOKEN") or None,
+        allow_patterns=["*.safetensors", "*.json", "*.txt", "*.jinja", "*.model"],
+    )
+    return path
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description="HF checkpoint downloader/converter")
+    ap.add_argument("--model", required=True, help="HF repo id, e.g. Qwen/Qwen3-0.6B")
+    ap.add_argument("--download-to", required=True, help="directory to place <model>/")
+    args = ap.parse_args()
+    out = download_snapshot(args.model, args.download_to)
+    print(f"downloaded {args.model} -> {out}")

@@ -1,0 +1,211 @@
+"""Inference gateway router: HTTP front door for N serving-engine replicas.
+
+TPU-native replacement for the llm-d inference gateway (Go) that the reference
+deploys via ``llmd-installer.sh`` and addresses at ``llm-d-test.yaml:14-26``.
+The contract preserved:
+
+- exposes the OpenAI surface (``/v1/*``) of the backends unchanged, so the L4
+  test playbook's ephemeral curl pods work against the router exactly as they
+  did against the llm-d gateway;
+- load-balances across every replica behind the backend Service by resolving
+  the DNS name to all A records per request batch (headless-Service friendly)
+  and round-robining over them — the "latent DP" the reference hinted at with
+  its two model PVCs (SURVEY.md §2.3);
+- retries idempotent-safe failures on the next replica, taking a dead backend
+  out of rotation for a cooldown window (the health-driven routing the
+  reference delegated to the external gateway);
+- streams responses through unbuffered (SSE passthrough for
+  ``stream: true`` completions).
+
+Stdlib-only (http.server + urllib) so the router container needs nothing
+beyond the framework image.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import logging
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger("tpu_serve.router")
+
+
+class BackendPool:
+    """Round-robin pool over the backend service's resolved replicas."""
+
+    def __init__(self, backend_service: str, refresh_s: float = 10.0,
+                 cooldown_s: float = 15.0):
+        host, sep, port = backend_service.rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise ValueError(
+                f"--backend-service must be host:port, got {backend_service!r}")
+        self.host = host
+        self.port = int(port)
+        self.refresh_s = refresh_s
+        self.cooldown_s = cooldown_s
+        self._lock = threading.Lock()
+        self._addrs: list[str] = []
+        self._rr = itertools.count()
+        self._dead: dict[str, float] = {}
+        self._last_refresh = 0.0
+
+    def _resolve(self) -> list[str]:
+        try:
+            infos = socket.getaddrinfo(self.host, self.port, socket.AF_INET,
+                                       socket.SOCK_STREAM)
+            return sorted({i[4][0] for i in infos})
+        except socket.gaierror:
+            return []
+
+    def pick(self) -> list[str]:
+        """Return candidate backends, healthiest-first (round-robin rotation)."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_refresh > self.refresh_s or not self._addrs:
+                addrs = self._resolve()
+                if addrs:
+                    self._addrs = addrs
+                self._last_refresh = now
+            self._dead = {a: t for a, t in self._dead.items()
+                          if now - t < self.cooldown_s}
+            alive = [a for a in self._addrs if a not in self._dead]
+            pool = alive or self._addrs  # all dead → try everything anyway
+            if not pool:
+                return []
+            k = next(self._rr) % len(pool)
+            return pool[k:] + pool[:k]
+
+    def mark_dead(self, addr: str):
+        with self._lock:
+            self._dead[addr] = time.monotonic()
+
+    def url(self, addr: str, path: str) -> str:
+        return f"http://{addr}:{self.port}{path}"
+
+
+class RouterHandler(BaseHTTPRequestHandler):
+    pool: BackendPool = None  # injected by serve()
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet; structured logging below
+        log.debug(fmt, *args)
+
+    def _respond_json(self, code: int, obj: dict):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _proxy(self, method: str):
+        if self.path == "/health":
+            self._respond_json(200, {"status": "ok",
+                                     "backends": self.pool._addrs})
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else None
+        candidates = self.pool.pick()
+        if not candidates:
+            self._respond_json(503, {"error": {
+                "message": "no serving backends resolved", "type": "router_error"}})
+            return
+        last_err = None
+        for addr in candidates:
+            # Phase 1: reach the backend. Failures here are retryable — nothing
+            # has been written to the client yet.
+            try:
+                req = urllib.request.Request(
+                    self.pool.url(addr, self.path), data=body, method=method)
+                for h in ("Content-Type", "Authorization", "Accept"):
+                    if self.headers.get(h):
+                        req.add_header(h, self.headers[h])
+                resp = urllib.request.urlopen(req, timeout=600)
+            except urllib.error.HTTPError as e:
+                # Backend spoke HTTP: a 4xx/5xx is the app's answer, not a dead
+                # replica — pass it through.
+                data = e.read()
+                self.send_response(e.code)
+                self.send_header("Content-Type",
+                                 e.headers.get("Content-Type", "application/json"))
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
+                self.pool.mark_dead(addr)
+                last_err = e
+                log.warning("backend %s failed (%s); trying next", addr, e)
+                continue
+            # Phase 2: relay to the client. The response has started — a
+            # failure here must NOT retry another replica (that would splice a
+            # second status line into the body) and a client disconnect
+            # (BrokenPipeError) must NOT mark the backend dead.
+            try:
+                self.send_response(resp.status)
+                ctype = resp.headers.get("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
+                if "text/event-stream" in ctype:
+                    # SSE: stream chunks through unbuffered; connection close
+                    # delimits the body.
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    while True:
+                        chunk = resp.read(4096)
+                        if not chunk:
+                            break
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                else:
+                    data = resp.read()
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+            except BrokenPipeError:
+                log.info("client disconnected mid-response")
+                self.close_connection = True
+            except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
+                # Backend died mid-body: response is unsalvageable; cut the
+                # connection so the client sees a truncated body, not a corrupt one.
+                self.pool.mark_dead(addr)
+                log.warning("backend %s died mid-response: %s", addr, e)
+                self.close_connection = True
+            return
+        self._respond_json(502, {"error": {
+            "message": f"all backends failed: {last_err}", "type": "router_error"}})
+
+    def do_GET(self):
+        self._proxy("GET")
+
+    def do_POST(self):
+        self._proxy("POST")
+
+
+def serve(backend_service: str, host: str, port: int):
+    RouterHandler.pool = BackendPool(backend_service)
+    httpd = ThreadingHTTPServer((host, port), RouterHandler)
+    log.info("router listening on %s:%d -> %s", host, port, backend_service)
+    httpd.serve_forever()
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    p = argparse.ArgumentParser(description="TPU serving gateway router")
+    p.add_argument("--backend-service", required=True,
+                   help="host:port of the engine Service (DNS resolved to replicas)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    args = p.parse_args(argv)
+    serve(args.backend_service, args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

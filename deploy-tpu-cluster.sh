@@ -1,0 +1,137 @@
+#!/usr/bin/env bash
+# L0 CLI orchestrator for the TPU-native serving stack.
+#
+# Behavioral contract mirrors the reference CLI (reference deploy-k8s-cluster.sh:93-117):
+#   - subcommand dispatch: deploy | cleanup | -h/--help, default = deploy
+#   - sequences the five layers L1..L5 as ansible-playbook invocations
+#   - hands the generated inventory file from L1 to L2..L5 (newest-wins discovery,
+#     reference deploy-k8s-cluster.sh:23)
+#   - prints a connection summary parsed from the details file at the end
+#     (reference deploy-k8s-cluster.sh:50-74)
+#   - fail-fast, no rollback: a half-built TPU VM keeps running until `cleanup`
+#     (reference deploy-k8s-cluster.sh:3 `set -e` semantics)
+#
+# TPU-first deltas (not a translation):
+#   - ALL shared values come from one source: the Python config module emits
+#     deploy/group_vars/all.yaml before any playbook runs. The reference coupled
+#     its layers by duplicated literals (SURVEY.md §1 "Key structural fact");
+#     here a playbook never hard-codes a version, namespace, or model id.
+#   - provisioning targets GCP TPU VMs (gcloud) instead of AWS EC2 (boto3).
+set -euo pipefail
+
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+DEPLOY_DIR="${SCRIPT_DIR}/deploy"
+PYTHON="${PYTHON:-python3}"
+
+usage() {
+    cat <<'EOF'
+Usage: ./deploy-tpu-cluster.sh [deploy|cleanup|-h|--help]
+
+  deploy    Provision a GCP TPU VM, install a single-node Kubernetes cluster
+            (CRI-O + Flannel + TPU device plugin), deploy the JAX serving
+            engine behind an inference gateway, smoke-test the OpenAI API,
+            and stand up the OTEL observability stack.  (default)
+  cleanup   Delete every TPU VM recorded in tpu-inventory-*.ini and remove
+            the generated local state files.
+
+Prerequisites: gcloud authenticated (gcloud auth login + application-default),
+ansible-playbook on PATH, HF token at ~/.cache/huggingface/token.
+EOF
+}
+
+generate_group_vars() {
+    # Single config source: every value the playbooks share with the engine is
+    # emitted here, once (replaces the reference's per-playbook vars blocks).
+    mkdir -p "${DEPLOY_DIR}/group_vars"
+    "${PYTHON}" -m aws_k8s_ansible_provisioner_tpu.config --ansible-vars \
+        > "${DEPLOY_DIR}/group_vars/all.yaml"
+    echo "Wrote ${DEPLOY_DIR}/group_vars/all.yaml (single-source deploy vars)"
+}
+
+newest_inventory() {
+    # Newest-wins inventory discovery (contract from reference deploy-k8s-cluster.sh:23).
+    ls -rt "${SCRIPT_DIR}"/tpu-inventory-*.ini 2>/dev/null | tail -1
+}
+
+deploy_cluster() {
+    echo "=== TPU cluster deploy: L1 provision → L2 cluster → L3 serving → L4 test → L5 observability ==="
+    generate_group_vars
+
+    echo "--- [L1] Launching TPU VM ---"
+    ansible-playbook "${DEPLOY_DIR}/launch-tpu-vm.yaml"
+
+    local inv
+    inv="$(newest_inventory)"
+    if [[ -z "${inv}" ]]; then
+        echo "ERROR: no tpu-inventory-*.ini produced by launch-tpu-vm.yaml" >&2
+        exit 1
+    fi
+    echo "Using inventory: ${inv}"
+
+    echo "--- [L2] Bootstrapping single-node Kubernetes (CRI-O + Flannel + TPU plugin) ---"
+    ansible-playbook -i "${inv}" "${DEPLOY_DIR}/kubernetes-single-node.yaml"
+
+    echo "--- [L3] Deploying JAX serving engine + inference gateway ---"
+    ansible-playbook -i "${inv}" "${DEPLOY_DIR}/serving-deploy.yaml"
+
+    echo "--- [L4] Smoke-testing the OpenAI API through the gateway ---"
+    ansible-playbook -i "${inv}" "${DEPLOY_DIR}/serving-test.yaml"
+
+    echo "--- [L5] Installing OTEL observability stack ---"
+    ansible-playbook -i "${inv}" "${DEPLOY_DIR}/otel-observability-setup.yaml"
+
+    print_summary
+}
+
+print_summary() {
+    # Parse the newest details file for the human-facing summary
+    # (reference deploy-k8s-cluster.sh:50-74 behavior).
+    local details
+    details="$(ls -rt "${SCRIPT_DIR}"/tpu-instance-*-details.txt 2>/dev/null | tail -1)"
+    echo ""
+    echo "=== Deployment complete ==="
+    if [[ -n "${details}" ]]; then
+        local name zone ip
+        name="$(grep -E '^tpu_name=' "${details}" | cut -d= -f2- || true)"
+        zone="$(grep -E '^zone=' "${details}" | cut -d= -f2- || true)"
+        ip="$(grep -E '^external_ip=' "${details}" | cut -d= -f2- || true)"
+        echo "TPU VM:      ${name:-unknown}"
+        echo "Zone:        ${zone:-unknown}"
+        echo "External IP: ${ip:-unknown}"
+        echo "SSH:         gcloud compute tpus tpu-vm ssh ${name} --zone ${zone}"
+        echo "API:         kubectl -n \$(serving ns) port-forward svc/tpu-inference-gateway 8000:80"
+    else
+        echo "(no details file found)"
+    fi
+}
+
+cleanup_instances() {
+    # Guard identical in spirit to reference deploy-k8s-cluster.sh:81: nothing to do
+    # when no inventory files exist.
+    if ! ls "${SCRIPT_DIR}"/tpu-inventory-*.ini >/dev/null 2>&1; then
+        echo "No tpu-inventory-*.ini files found — nothing to clean up."
+        exit 0
+    fi
+    generate_group_vars
+    ansible-playbook "${DEPLOY_DIR}/cleanup-tpu-vm.yaml"
+}
+
+case "${1:-deploy}" in
+    deploy)
+        if [[ $# -gt 1 ]]; then
+            echo "ERROR: deploy takes no extra arguments" >&2; usage; exit 1
+        fi
+        deploy_cluster
+        ;;
+    cleanup)
+        cleanup_instances
+        ;;
+    -h|--help)
+        usage
+        ;;
+    *)
+        echo "Unknown subcommand: $1" >&2
+        usage
+        exit 1
+        ;;
+esac

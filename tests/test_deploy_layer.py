@@ -1,0 +1,208 @@
+"""Deploy-layer structural tests: the L0 CLI and L1-L5 playbooks.
+
+The reference had zero tests for its automation (SURVEY.md §4); we validate the
+pipeline without cloud access: bash syntax, YAML well-formedness, play/task
+structure, the single-config-source contract, and Jinja manifest rendering."""
+
+import json
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+import yaml
+
+REPO = Path(__file__).resolve().parent.parent
+DEPLOY = REPO / "deploy"
+PLAYBOOKS = [
+    "launch-tpu-vm.yaml",
+    "cleanup-tpu-vm.yaml",
+    "kubernetes-single-node.yaml",
+    "serving-deploy.yaml",
+    "serving-test.yaml",
+    "otel-observability-setup.yaml",
+]
+
+
+def _load(path: Path):
+    return yaml.safe_load(path.read_text())
+
+
+def test_cli_bash_syntax():
+    bash = shutil.which("bash")
+    if bash is None:
+        pytest.skip("bash not available")
+    subprocess.run([bash, "-n", str(REPO / "deploy-tpu-cluster.sh")], check=True)
+
+
+def test_cli_dispatches_all_layers():
+    text = (REPO / "deploy-tpu-cluster.sh").read_text()
+    for pb in PLAYBOOKS:
+        if pb == "cleanup-tpu-vm.yaml":
+            continue
+        assert pb in text, f"CLI does not sequence {pb}"
+    for sub in ("deploy)", "cleanup)", "-h|--help)"):
+        assert sub in text, f"CLI missing subcommand {sub}"
+
+
+@pytest.mark.parametrize("name", PLAYBOOKS)
+def test_playbook_parses_as_yaml(name):
+    plays = _load(DEPLOY / name)
+    assert isinstance(plays, list) and plays, name
+    for play in plays:
+        assert "hosts" in play, f"{name}: play without hosts"
+        assert "tasks" in play, f"{name}: play without tasks"
+
+
+def test_launch_writes_contract_files():
+    plays = _load(DEPLOY / "launch-tpu-vm.yaml")
+    text = (DEPLOY / "launch-tpu-vm.yaml").read_text()
+    # the inventory + details files are THE layer handoff (SURVEY.md §1 L1 row)
+    assert "tpu-inventory-" in text
+    assert "tpu-instance-" in text and "-details.txt" in text
+    # play 2 must run against the provisioned host group
+    assert plays[1]["hosts"] == "tpu_instances"
+
+
+def test_cluster_playbook_has_five_layer_parity():
+    text = (DEPLOY / "kubernetes-single-node.yaml").read_text()
+    for needle in ("kubeadm init", "flannel", "local-path", "google.com/tpu",
+                   "kube-prometheus-stack", "tpu-metrics"):
+        assert needle in text, f"cluster playbook missing {needle}"
+
+
+def test_serving_test_preserves_acceptance_gate():
+    text = (DEPLOY / "serving-test.yaml").read_text()
+    assert "/v1/models" in text
+    assert "/v1/completions" in text
+    assert "Who are you?" in text  # the reference's canonical prompt
+    plays = _load(DEPLOY / "serving-test.yaml")
+    asserts = [t for t in plays[0]["tasks"] if "ansible.builtin.assert" in t]
+    assert asserts, "smoke test lost its hard assert (reference llm-d-test.yaml:54-59)"
+
+
+def test_no_hardcoded_duplicated_literals():
+    """The reference's flaw: same literal duplicated across playbooks (SURVEY.md
+    §1). Our playbooks must reference vars, not repeat model ids/namespaces."""
+    for name in PLAYBOOKS:
+        text = (DEPLOY / name).read_text()
+        assert "Qwen/Qwen3-0.6B" not in text, f"{name} hard-codes the model id"
+        # kubernetes version must come from group_vars, not a literal
+        assert "v1.33" not in text.replace("{{ kubernetes_version }}", "")
+
+
+def test_ansible_vars_single_source():
+    from aws_k8s_ansible_provisioner_tpu.config import ansible_vars
+
+    rendered = ansible_vars()
+    data = yaml.safe_load(rendered)
+    # every templated var used by the playbooks must be emitted by the config
+    needed = {
+        "gcp_project", "gcp_zone", "tpu_accelerator_type", "tpu_runtime_version",
+        "tpu_name_prefix", "ssh_user", "kubernetes_version", "crio_version",
+        "pod_network_cidr", "serving_namespace", "gateway_name", "storage_class",
+        "model_storage_gi", "otel_namespace", "observability_namespace",
+        "cluster_name", "metrics_scrape_interval_s", "model", "serving_port",
+        "framework_image", "serving_replicas",
+    }
+    missing = needed - set(data)
+    assert not missing, f"config does not emit: {missing}"
+    # engine-owned values flow FROM ServingConfig (no second copy)
+    assert data["model"] == "Qwen/Qwen3-0.6B"
+    assert data["serving_port"] == 8000
+
+
+def _render_manifest(path: Path) -> str:
+    import jinja2
+
+    from aws_k8s_ansible_provisioner_tpu.config import ansible_vars
+
+    vars_ = yaml.safe_load(ansible_vars())
+    env = jinja2.Environment(undefined=jinja2.StrictUndefined)
+    return env.from_string(path.read_text()).render(**vars_)
+
+
+@pytest.mark.parametrize("manifest", sorted(
+    p.name for p in (DEPLOY / "manifests").glob("*.yaml.j2")))
+def test_manifests_render_and_parse(manifest):
+    rendered = _render_manifest(DEPLOY / "manifests" / manifest)
+    docs = [d for d in yaml.safe_load_all(rendered) if d]
+    assert docs, manifest
+    for doc in docs:
+        assert "kind" in doc and "apiVersion" in doc, manifest
+
+
+def test_serving_manifest_contracts():
+    docs = {(d["kind"], d["metadata"]["name"]): d for d in yaml.safe_load_all(
+        _render_manifest(DEPLOY / "manifests" / "serving.yaml.j2")) if d}
+    engine = docs[("Deployment", "tpu-serving-engine")]
+    pod = engine["spec"]["template"]
+    # annotation-gated scrape contract (reference otel-observability-setup.yaml:345-368)
+    assert pod["metadata"]["annotations"]["prometheus.io/scrape"] == "true"
+    assert pod["metadata"]["annotations"]["prometheus.io/port"] == "8000"
+    # TPU resource request (the google.com/tpu ← nvidia.com/gpu swap)
+    limits = pod["spec"]["containers"][0]["resources"]["limits"]
+    assert limits["google.com/tpu"] == 1
+    # HF token only via secret, never argv (fixes reference llm-d-deploy.yaml:178)
+    job = docs[("Job", "model-download")]
+    container = job["spec"]["template"]["spec"]["containers"][0]
+    assert "HF_TOKEN" not in " ".join(container["command"])
+    envs = {e["name"]: e for e in container["env"]}
+    assert "secretKeyRef" in envs["HF_TOKEN"]["valueFrom"]
+    # gateway fronts the engine service
+    gw = docs[("Deployment", "tpu-inference-gateway")]
+    cmd = " ".join(gw["spec"]["template"]["spec"]["containers"][0]["command"])
+    assert "router" in cmd and "tpu-serving-engine" in cmd
+
+
+def test_chat_template_configmaps_ship_and_render():
+    """Reference shipped phi/opt templates but wired neither (SURVEY.md §2.1 #18).
+    We ship phi + opt + qwen and serving.yaml.j2 mounts one."""
+    import jinja2
+
+    tpl_dir = REPO / "templates"
+    names = set()
+    for f in sorted(tpl_dir.glob("*.yaml")):
+        cm = _load(f)
+        assert cm["kind"] == "ConfigMap"
+        names.add(cm["metadata"]["name"])
+        jinja = cm["data"]["template.jinja"]
+        env = jinja2.Environment()
+        out = env.from_string(jinja).render(
+            messages=[{"role": "system", "content": "sys"},
+                      {"role": "user", "content": "hello"}],
+            add_generation_prompt=True)
+        assert "hello" in out
+        assert "sys" in out
+    assert {"phi-chat-template", "opt-chat-template", "qwen-chat-template"} <= names
+    rendered = _render_manifest(DEPLOY / "manifests" / "serving.yaml.j2")
+    assert "qwen-chat-template" in rendered
+
+
+def test_cleanup_removes_local_state():
+    text = (DEPLOY / "cleanup-tpu-vm.yaml").read_text()
+    for needle in ("tpu-inventory-*.ini", "tpu-instance-*-details.txt",
+                   "kubeconfig-*", "tpus tpu-vm delete"):
+        assert needle in text
+
+
+def test_otel_preserves_pipeline_shape():
+    text = (DEPLOY / "otel-observability-setup.yaml").read_text()
+    # 5 scrape jobs, processor chain, remote-write — reference :297-642 shape
+    for job in ("engine-metrics", "tpu-metrics-exporter", "tpu-exporter-pods",
+                "kubernetes-nodes", "kubernetes-cadvisor"):
+        assert f"job_name: {job}" in text
+    for proc in ("memory_limiter", "metricstransform", "k8sattributes",
+                 "resourcedetection", "batch"):
+        assert proc in text
+    assert "prometheusremotewrite" in text
+    assert "--web.enable-remote-write-receiver" in text
+
+
+def test_engine_service_is_headless():
+    """Router does per-replica DNS load balancing — needs pod IPs, not a VIP."""
+    docs = {(d["kind"], d["metadata"]["name"]): d for d in yaml.safe_load_all(
+        _render_manifest(DEPLOY / "manifests" / "serving.yaml.j2")) if d}
+    svc = docs[("Service", "tpu-serving-engine")]
+    # k8s headless convention is the literal string "None"
+    assert svc["spec"]["clusterIP"] == "None"

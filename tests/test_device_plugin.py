@@ -1,0 +1,167 @@
+"""Device plugin tests: protobuf wire encoding and the kubelet gRPC lifecycle.
+
+The plugin replaces the GPU Operator's device-plugin role (reference
+kubernetes-single-node.yaml:338-348 → `nvidia.com/gpu`; ours → `google.com/tpu`).
+Tests run the real grpc server over a unix socket in a tmpdir with a fake
+kubelet Registration service."""
+
+import os
+import threading
+from concurrent import futures
+
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.k8s import protowire as pw
+from aws_k8s_ansible_provisioner_tpu.k8s.device_plugin import (
+    API_VERSION, RESOURCE_NAME, DevicePluginServicer, build_server,
+    register_with_kubelet,
+)
+
+grpc = pytest.importorskip("grpc")
+
+
+# ---------------------------------------------------------------------------
+# protowire round-trips
+# ---------------------------------------------------------------------------
+
+def test_varint_roundtrip():
+    for n in (0, 1, 127, 128, 300, 2 ** 32, 2 ** 60):
+        buf = pw._varint(n)
+        val, pos = pw.decode_varint(buf, 0)
+        assert val == n and pos == len(buf)
+
+
+def test_register_request_fields():
+    buf = pw.register_request("v1beta1", "tpu.sock", RESOURCE_NAME)
+    fields = {f: v for f, _, v in pw.iter_fields(buf)}
+    assert fields[1] == b"v1beta1"
+    assert fields[2] == b"tpu.sock"
+    assert fields[3] == RESOURCE_NAME.encode()
+
+
+def test_list_and_watch_response_devices():
+    buf = pw.list_and_watch_response(["/dev/accel0", "/dev/accel1"])
+    devs = [v for f, _, v in pw.iter_fields(buf) if f == 1]
+    assert len(devs) == 2
+    ids = [dict((f, v) for f, _, v in pw.iter_fields(d))[1] for d in devs]
+    assert ids == [b"/dev/accel0", b"/dev/accel1"]
+    healths = [dict((f, v) for f, _, v in pw.iter_fields(d))[2] for d in devs]
+    assert healths == [b"Healthy", b"Healthy"]
+
+
+def test_allocate_request_parse():
+    # Build an AllocateRequest the way the kubelet would.
+    container = pw.encode_string(1, "/dev/accel0") + pw.encode_string(1, "/dev/accel1")
+    req = pw.encode_message(1, container) + pw.encode_message(
+        1, pw.encode_string(1, "/dev/accel2"))
+    parsed = pw.parse_allocate_request(req)
+    assert parsed == [["/dev/accel0", "/dev/accel1"], ["/dev/accel2"]]
+
+
+def test_container_allocate_response_mounts_devices():
+    buf = pw.container_allocate_response(
+        {"TPU_VISIBLE_CHIPS": "0,1"}, ["/dev/accel0", "/dev/accel1"])
+    env_entries = [v for f, _, v in pw.iter_fields(buf) if f == 1]
+    assert len(env_entries) == 1
+    kv = dict((f, v) for f, _, v in pw.iter_fields(env_entries[0]))
+    assert kv[1] == b"TPU_VISIBLE_CHIPS" and kv[2] == b"0,1"
+    dev_specs = [v for f, _, v in pw.iter_fields(buf) if f == 3]
+    assert len(dev_specs) == 2
+
+
+# ---------------------------------------------------------------------------
+# gRPC service over a unix socket
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def plugin_server(tmp_path):
+    sock = tmp_path / "plugin.sock"
+    servicer = DevicePluginServicer(["/dev/accel0", "/dev/accel1"], poll_s=0.05)
+    server = build_server(servicer, f"unix://{sock}")
+    server.start()
+    yield f"unix://{sock}"
+    server.stop(0)
+
+
+def test_get_device_plugin_options(plugin_server):
+    channel = grpc.insecure_channel(plugin_server)
+    call = channel.unary_unary(
+        f"/{API_VERSION}.DevicePlugin/GetDevicePluginOptions",
+        request_serializer=lambda b: b, response_deserializer=lambda b: b)
+    resp = call(b"")
+    # both bools false → zero varints present with value 0
+    fields = {f: v for f, _, v in pw.iter_fields(resp)}
+    assert fields.get(1, 0) == 0
+    channel.close()
+
+
+def test_allocate_rpc_sets_tpu_env(plugin_server):
+    channel = grpc.insecure_channel(plugin_server)
+    call = channel.unary_unary(
+        f"/{API_VERSION}.DevicePlugin/Allocate",
+        request_serializer=lambda b: b, response_deserializer=lambda b: b)
+    req = pw.encode_message(1, pw.encode_string(1, "/dev/accel0"))
+    resp = call(req)
+    containers = [v for f, _, v in pw.iter_fields(resp) if f == 1]
+    assert len(containers) == 1
+    envs = [v for f, _, v in pw.iter_fields(containers[0]) if f == 1]
+    keys = {dict((f, v) for f, _, v in pw.iter_fields(e))[1] for e in envs}
+    assert b"TPU_VISIBLE_CHIPS" in keys
+    channel.close()
+
+
+def test_registration_against_fake_kubelet(tmp_path):
+    """End-to-end: plugin registers with a fake kubelet Registration service."""
+    received = {}
+    done = threading.Event()
+
+    def register(request: bytes, context) -> bytes:
+        fields = {f: v for f, _, v in pw.iter_fields(request)}
+        received["version"] = fields[1].decode()
+        received["endpoint"] = fields[2].decode()
+        received["resource"] = fields[3].decode()
+        done.set()
+        return b""
+
+    ident = lambda b: b  # noqa: E731
+    kubelet = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    kubelet.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
+        f"{API_VERSION}.Registration",
+        {"Register": grpc.unary_unary_rpc_method_handler(register, ident, ident)}),))
+    kubelet_sock = tmp_path / "kubelet.sock"
+    kubelet.add_insecure_port(f"unix://{kubelet_sock}")
+    kubelet.start()
+    try:
+        register_with_kubelet(str(kubelet_sock), "tpu-device-plugin.sock")
+        assert done.wait(5)
+        assert received == {
+            "version": API_VERSION,
+            "endpoint": "tpu-device-plugin.sock",
+            "resource": RESOURCE_NAME,
+        }
+    finally:
+        kubelet.stop(0)
+
+
+def test_chip_index_from_device_path():
+    from aws_k8s_ansible_provisioner_tpu.k8s.device_plugin import _chip_index
+    assert _chip_index("/dev/accel3") == "3"
+    assert _chip_index("/dev/vfio/7") == "7"
+    assert _chip_index("/dev/accel") == "0"
+
+
+def test_allocate_uses_actual_chip_indices(plugin_server):
+    """Two pods on one host must NOT both get chips 0..n-1 (review finding)."""
+    channel = grpc.insecure_channel(plugin_server)
+    call = channel.unary_unary(
+        f"/{API_VERSION}.DevicePlugin/Allocate",
+        request_serializer=lambda b: b, response_deserializer=lambda b: b)
+    req = pw.encode_message(1, pw.encode_string(1, "/dev/accel2")
+                            + pw.encode_string(1, "/dev/accel3"))
+    resp = call(req)
+    containers = [v for f, _, v in pw.iter_fields(resp) if f == 1]
+    envs = [v for f, _, v in pw.iter_fields(containers[0]) if f == 1]
+    kv = {dict((f, v) for f, _, v in pw.iter_fields(e))[1]:
+          dict((f, v) for f, _, v in pw.iter_fields(e))[2] for e in envs}
+    assert kv[b"TPU_VISIBLE_CHIPS"] == b"2,3"
+    channel.close()

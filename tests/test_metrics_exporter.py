@@ -1,0 +1,81 @@
+"""TPU metrics exporter tests: Prometheus text rendering and the HTTP scrape
+endpoint (the DCGM-exporter scrape-shape contract, reference
+kubernetes-single-node.yaml:480-504)."""
+
+import json
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.k8s.metrics_exporter import (
+    ExporterHandler, TpuTelemetry, render_prometheus,
+)
+
+CHIPS = [
+    {"chip": "0", "kind": "v5e", "hbm_used": 1024.0, "hbm_capacity": 2048.0,
+     "duty_cycle": 50.0, "tensorcore_util": 25.0},
+    {"chip": "1", "kind": "v5e", "hbm_used": 0.0, "hbm_capacity": 2048.0,
+     "duty_cycle": 0.0, "tensorcore_util": 0.0},
+]
+
+
+def test_render_prometheus_families():
+    text = render_prometheus(CHIPS)
+    assert "tpu_exporter_up 1" in text
+    assert "tpu_chips_total 2" in text
+    assert 'tpu_hbm_used_bytes{chip="0",kind="v5e"} 1024' in text
+    assert 'tpu_hbm_capacity_bytes{chip="1",kind="v5e"} 2048' in text
+    assert 'tpu_duty_cycle_percent{chip="0",kind="v5e"} 50' in text
+    # every family carries HELP/TYPE headers (Prometheus exposition format)
+    for fam in ("tpu_hbm_used_bytes", "tpu_duty_cycle_percent",
+                "tpu_tensorcore_utilization_percent"):
+        assert f"# HELP {fam}" in text
+        assert f"# TYPE {fam} gauge" in text
+
+
+def test_render_empty_host_keeps_target_alive():
+    text = render_prometheus([])
+    assert "tpu_exporter_up 1" in text
+    assert "tpu_chips_total 0" in text
+
+
+@pytest.fixture()
+def exporter():
+    telemetry = TpuTelemetry(use_jax=False)
+    telemetry._cache = CHIPS
+    telemetry._last_poll = float("inf")  # pin the snapshot
+    old = ExporterHandler.telemetry
+    ExporterHandler.telemetry = telemetry
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), ExporterHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    ExporterHandler.telemetry = old
+
+
+def test_scrape_endpoint(exporter):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.server_port}/metrics", timeout=10) as r:
+        assert r.status == 200
+        assert "text/plain" in r.headers["Content-Type"]
+        body = r.read().decode()
+    assert 'tpu_hbm_used_bytes{chip="0",kind="v5e"} 1024' in body
+
+
+def test_health_endpoint(exporter):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.server_port}/health", timeout=10) as r:
+        assert json.loads(r.read())["status"] == "ok"
+
+
+def test_telemetry_falls_back_to_devnodes(monkeypatch):
+    telemetry = TpuTelemetry(use_jax=False)
+    monkeypatch.setattr(
+        "aws_k8s_ansible_provisioner_tpu.k8s.metrics_exporter.discover_tpu_devices",
+        lambda: ["/dev/accel0"])
+    chips = telemetry.snapshot()
+    assert len(chips) == 1
+    assert chips[0]["chip"] == "0"

@@ -1,0 +1,134 @@
+"""Router (gateway) tests: proxying, failover, health — the llm-d gateway
+contract (reference llm-d-test.yaml:14-26 addresses it; SURVEY.md §2.2 row 2)."""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.serving.router import (
+    BackendPool, RouterHandler,
+)
+
+
+class FakeEngine(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/v1/models":
+            self._send(200, {"object": "list",
+                             "data": [{"id": "Qwen/Qwen3-0.6B"}],
+                             "port": self.server.server_port})
+        else:
+            self._send(404, {"error": "nope"})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        req = json.loads(self.rfile.read(n) or b"{}")
+        self._send(200, {"echo": req, "port": self.server.server_port})
+
+
+@pytest.fixture()
+def backend():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), FakeEngine)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture()
+def router(backend):
+    pool = BackendPool(f"127.0.0.1:{backend.server_port}")
+    old = RouterHandler.pool
+    RouterHandler.pool = pool
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    RouterHandler.pool = old
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_router_proxies_get(router):
+    status, body = _get(router.server_port, "/v1/models")
+    assert status == 200
+    assert body["data"][0]["id"] == "Qwen/Qwen3-0.6B"
+
+
+def test_router_proxies_post_body(router):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.server_port}/v1/completions",
+        data=json.dumps({"prompt": "hi", "max_tokens": 4}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        body = json.loads(r.read())
+    assert body["echo"]["prompt"] == "hi"
+
+
+def test_router_health_endpoint(router):
+    status, body = _get(router.server_port, "/health")
+    assert status == 200
+    assert body["status"] == "ok"
+
+
+def test_router_passes_through_backend_errors(router):
+    # A backend 404 is an application answer, not a dead replica.
+    try:
+        _get(router.server_port, "/v1/unknown")
+        raise AssertionError("expected HTTPError")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_router_503_when_no_backends():
+    pool = BackendPool("nonexistent.invalid:9")
+    old = RouterHandler.pool
+    RouterHandler.pool = pool
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        _get(srv.server_port, "/v1/models")
+        raise AssertionError("expected HTTPError")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+    finally:
+        srv.shutdown()
+        RouterHandler.pool = old
+
+
+def test_pool_rotation_and_cooldown():
+    pool = BackendPool("127.0.0.1:1234", cooldown_s=60)
+    pool._addrs = ["10.0.0.1", "10.0.0.2"]
+    pool._last_refresh = float("inf")  # freeze DNS refresh
+    first = pool.pick()[0]
+    second = pool.pick()[0]
+    assert {first, second} == {"10.0.0.1", "10.0.0.2"}  # round-robin
+    pool.mark_dead("10.0.0.1")
+    for _ in range(4):
+        assert pool.pick()[0] == "10.0.0.2"  # dead replica out of rotation
+
+
+def test_pool_rejects_malformed_backend_service():
+    for bad in ("no-port-here", "host:", ":8000", "host:notaport"):
+        with pytest.raises(ValueError):
+            BackendPool(bad)
