@@ -156,16 +156,27 @@ def run(kubelet_dir: str = KUBELET_DIR, once: bool = False):
         servicer = DevicePluginServicer(devices)
         server = build_server(servicer, f"unix://{sock_path}")
         server.start()
-        try:
-            register_with_kubelet(kubelet_sock, PLUGIN_SOCKET)
-        except Exception as e:  # kubelet not up yet — retry loop below
-            log.warning("kubelet registration failed: %s", e)
+
+        def try_register() -> bool:
+            try:
+                register_with_kubelet(kubelet_sock, PLUGIN_SOCKET)
+                return True
+            except Exception as e:  # kubelet not up yet — keep retrying below
+                log.warning("kubelet registration failed: %s", e)
+                return False
+
+        registered = try_register()
         if once:
             server.stop(0)
             return
         # Watch for kubelet restarts: kubelet wipes its plugin dir on restart,
-        # deleting our socket — the signal to re-serve and re-register.
+        # deleting our socket — the signal to re-serve and re-register. Until
+        # registration has succeeded, keep retrying it on the same cadence
+        # (a transiently-unavailable kubelet must not strand the node at zero
+        # TPU capacity).
         while os.path.exists(sock_path):
+            if not registered:
+                registered = try_register()
             time.sleep(5)
         log.info("kubelet restart detected (socket removed); re-registering")
         server.stop(0)

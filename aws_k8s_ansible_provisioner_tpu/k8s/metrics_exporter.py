@@ -36,7 +36,10 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from aws_k8s_ansible_provisioner_tpu.k8s.device_plugin import discover_tpu_devices
+from aws_k8s_ansible_provisioner_tpu.k8s.device_plugin import (
+    _chip_index,
+    discover_tpu_devices,
+)
 
 log = logging.getLogger("tpu_serve.metrics_exporter")
 
@@ -78,8 +81,10 @@ class TpuTelemetry:
         return chips
 
     def _poll_devnodes(self) -> list[dict]:
+        # _chip_index keeps the label identical to what the device plugin
+        # exports in TPU_VISIBLE_CHIPS, so dashboards agree on chip identity.
         return [{
-            "chip": path.rsplit("/", 1)[-1].lstrip("accel"),
+            "chip": _chip_index(path),
             "kind": "tpu",
             "hbm_used": 0.0,
             "hbm_capacity": 0.0,

@@ -28,34 +28,57 @@ def decode_attend(q: jnp.ndarray, cache_k: jnp.ndarray, cache_v: jnp.ndarray,
                   lengths: jnp.ndarray) -> jnp.ndarray:
     """Cached decode attention for one new token per slot.
 
-    q: [B, 1, Hq, D]; cache_k/v: [B, S, Hkv, D] (already containing the new
-    token's k/v at position lengths-1... i.e. caller writes first); lengths: [B]
-    = number of valid rows per slot (including the new token).
+    q: [B, 1, Hq, D]; cache_k/v: [B, Hkv, S, D] head-major (already containing
+    the new token's k/v at position lengths-1... i.e. caller writes first);
+    lengths: [B] = number of valid rows per slot (including the new token).
     Returns [B, 1, Hq, D].
     """
     B, _, Hq, D = q.shape
-    S = cache_k.shape[1]
-    Hkv = cache_k.shape[2]
+    Hkv, S = cache_k.shape[1], cache_k.shape[2]
     G = Hq // Hkv
     qg = q[:, 0].reshape(B, Hkv, G, D).astype(jnp.float32)
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
-    logits = jnp.einsum("bkgd,bskd->bkgs", qg, cache_k.astype(jnp.float32)) * scale
+    logits = jnp.einsum("bkgd,bksd->bkgs", qg, cache_k.astype(jnp.float32)) * scale
     valid = jnp.arange(S)[None, :] < lengths[:, None]          # [B, S]
     logits = jnp.where(valid[:, None, None, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    ctx = jnp.einsum("bkgs,bskd->bkgd", probs, cache_v.astype(jnp.float32))
+    ctx = jnp.einsum("bkgs,bksd->bkgd", probs, cache_v.astype(jnp.float32))
     return ctx.reshape(B, 1, Hq, D).astype(q.dtype)
 
 
-def make_decode_attend(lengths: jnp.ndarray):
+def resolve_impl(impl: str = "auto") -> str:
+    """Resolve the decode-attention backend: 'pallas' on TPU, 'xla' elsewhere.
+
+    'auto' picks the Pallas flash kernel exactly when it compiles natively
+    (TPU); CPU tests exercise it explicitly via interpret mode. The
+    TPU_SERVE_ATTENTION_IMPL env var overrides for A/B perf comparison.
+    """
+    import os
+
+    impl = os.environ.get("TPU_SERVE_ATTENTION_IMPL", impl)
+    if impl == "auto":
+        from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
+
+        return "pallas" if pallas_attention.supported() else "xla"
+    return impl
+
+
+def make_decode_attend(lengths: jnp.ndarray, impl: str = "auto"):
     """Attend callback for model_forward: writes the new token, then attends.
 
     ``lengths`` are the pre-step lengths (position of the incoming token).
     """
+    resolved = resolve_impl(impl)
 
     def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
         cache_l = kvc.write_token(cache_l, lengths, k, v)
-        ctx = decode_attend(q, cache_l["k"], cache_l["v"], lengths + 1)
+        if resolved == "pallas":
+            from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
+
+            ctx = pallas_attention.decode_attend_pallas(
+                q, cache_l["k"], cache_l["v"], lengths + 1)
+        else:
+            ctx = decode_attend(q, cache_l["k"], cache_l["v"], lengths + 1)
         return ctx, cache_l
 
     return attend

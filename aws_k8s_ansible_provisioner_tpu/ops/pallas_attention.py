@@ -1,0 +1,158 @@
+"""Pallas TPU kernel: ragged decode attention over the slot-contiguous KV cache.
+
+This is the hot loop of the whole framework — the TPU-native equivalent of the
+paged-attention CUDA kernels inside the reference's external vLLM engine
+(SURVEY.md §3.3: "the true hot loop (token-by-token decode on the GPU) lives
+entirely inside the external vLLM container"; §7 hard part #1). One program
+instance handles one decode slot; the KV cache streams HBM→VMEM in chunks with
+flash-style online softmax, so per-step cost is cache-bandwidth-bound with no
+[B, S] float32 logits materialization in HBM.
+
+Raggedness (every slot at a different length) is handled two ways:
+- masking: key columns ≥ length contribute -inf logits;
+- *DMA skipping*: the chunk index_map clamps dead chunks (beyond the slot's
+  length) to the last live chunk — Pallas skips re-fetch when a block index
+  repeats, so a slot at length 130 reads ~2 chunks of cache, not S/CHUNK.
+  With the identity block table of the slot-contiguous cache
+  (serving/kv_cache.py pages_view), this IS paged attention: chunk c of slot b
+  is page ``b*pages_per_slot + c``.
+
+GQA grouping stays in-kernel: per KV head h, the G=Hq/Hkv query rows attend to
+one [CHUNK, D] K/V stream — no repeat_kv copy ever exists (the same design as
+the XLA fallback in ops/attention.py, here with explicit VMEM control).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(lengths_ref,            # scalar prefetch [B] int32
+                   q_ref,                  # [1, Hq, D]
+                   k_ref,                  # [1, Hkv, CHUNK, D]
+                   v_ref,                  # [1, Hkv, CHUNK, D]
+                   o_ref,                  # [1, Hq, D]
+                   acc_ref,                # VMEM [Hq, D] f32
+                   m_ref,                  # VMEM [Hq, 128] f32
+                   l_ref,                  # VMEM [Hq, 128] f32
+                   *, chunk: int, groups: int, scale: float):
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    num_chunks = pl.num_programs(1)
+    length = lengths_ref[b]
+    hq, d = q_ref.shape[1], q_ref.shape[2]
+    hkv = k_ref.shape[1]
+
+    @pl.when(c == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Live chunk: flash accumulation. Dead chunks (start ≥ length) skip compute;
+    # their DMA was already skipped by the clamped index_map. The head-major
+    # cache layout makes this ONE batched MXU matmul over all kv heads — the
+    # [Hq, D]-row-major q reshaped to [Hkv, G, D] lines up head h's G query
+    # rows against its contiguous [CHUNK, D] K/V stream.
+    @pl.when(c * chunk < length)
+    def _accumulate():
+        q3 = (q_ref[0].astype(jnp.float32) * scale).reshape(hkv, groups, d)
+        k3 = k_ref[0].astype(jnp.float32)                         # [Hkv, C, D]
+        s = jax.lax.dot_general(
+            q3, k3, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)                   # [Hkv, G, C]
+        s = s.reshape(hq, chunk)
+        col = c * chunk + jax.lax.broadcasted_iota(jnp.int32, (hq, chunk), 1)
+        s = jnp.where(col < length, s, NEG_INF)
+        m_prev = m_ref[:, :1]                                     # [Hq, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)                                    # [Hq, C]
+        l_cur = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        v3 = v_ref[0].astype(jnp.float32)                         # [Hkv, C, D]
+        pv = jax.lax.dot_general(
+            p.reshape(hkv, groups, chunk), v3,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)                   # [Hkv, G, D]
+        acc_ref[:] = acc_ref[:] * corr + pv.reshape(hq, d)
+        m_ref[:, :1] = m_cur
+        l_ref[:, :1] = l_cur
+
+    @pl.when(c == num_chunks - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-9)   # len-0 slots: garbage, not NaN
+        o_ref[0, :, :] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "interpret"))
+def decode_attend_pallas(q: jnp.ndarray, cache_k: jnp.ndarray,
+                         cache_v: jnp.ndarray, lengths: jnp.ndarray,
+                         chunk: int = 256, interpret: bool = False) -> jnp.ndarray:
+    """Flash decode attention: q [B,1,Hq,D] over cache [B,Hkv,S,D] (head-major,
+    see serving/kv_cache.py), ragged by ``lengths`` [B] (counting the
+    just-written token). Returns [B,1,Hq,D].
+
+    Drop-in replacement for ops.attention.decode_attend (same contract: caller
+    writes the new token's K/V at position lengths-1 first).
+    """
+    B, _, Hq, D = q.shape
+    Hkv, S = cache_k.shape[1], cache_k.shape[2]
+    groups = Hq // Hkv
+    # Largest divisor of S not exceeding the requested chunk, so any cache
+    # length works (a non-divisible --max-cache-len must not crash on TPU).
+    chunk = min(chunk, S)
+    while S % chunk:
+        chunk -= 1
+    num_chunks = S // chunk
+    lengths = lengths.astype(jnp.int32)
+
+    def q_map(b, c, lens):
+        return (b, 0, 0)
+
+    def kv_map(b, c, lens):
+        # Clamp dead chunks to the last live one: repeated block index → Pallas
+        # skips the re-fetch, so short slots don't pay full-S bandwidth.
+        live = jnp.maximum(pl.cdiv(lens[b], chunk) - 1, 0)
+        return (b, 0, jnp.minimum(c, live), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, num_chunks),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), q_map),
+            pl.BlockSpec((1, Hkv, chunk, D), kv_map),
+            pl.BlockSpec((1, Hkv, chunk, D), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((Hq, D), jnp.float32),
+            pltpu.VMEM((Hq, 128), jnp.float32),
+            pltpu.VMEM((Hq, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, chunk=chunk, groups=groups,
+        scale=1.0 / (D ** 0.5))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        interpret=interpret,
+    )(lengths, q[:, 0], cache_k, cache_v)
+    return out[:, None]
+
+
+def supported(cfg=None) -> bool:
+    """Pallas decode path is compiled only on TPU backends (interpret elsewhere)."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False

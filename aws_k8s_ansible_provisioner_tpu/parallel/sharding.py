@@ -16,7 +16,7 @@ Layout summary (weights are ``[in, out]``, layers stacked on a leading L axis):
   sharded on the output dim.
 - norms and per-head q/k norms: replicated (tiny).
 - token/position arrays: batch over ``dp``, sequence over ``sp``.
-- decode KV cache ``[L, slots, S, Hkv, D]``: kv heads over ``tp``, slots over
+- decode KV cache ``[L, slots, Hkv, S, D]``: kv heads over ``tp``, slots over
   ``dp`` (each data-parallel group owns its slots).
 """
 
@@ -98,10 +98,10 @@ def param_pspecs(cfg: ModelConfig) -> dict:
 
 
 def cache_pspecs() -> dict:
-    """Decode cache [L, slots, S, Hkv, D]: slots over dp, kv heads over tp."""
+    """Decode cache [L, slots, Hkv, S, D]: slots over dp, kv heads over tp."""
     return {
-        "k": P(None, "dp", None, "tp", None),
-        "v": P(None, "dp", None, "tp", None),
+        "k": P(None, "dp", "tp", None, None),
+        "v": P(None, "dp", "tp", None, None),
     }
 
 

@@ -2,19 +2,22 @@
 
 The reference's KV cache lives inside the external vLLM container (paged attention
 over CUDA kernels; SURVEY.md §2.2 row 1). The TPU-native equivalent here uses a
-**slot-contiguous** layout: one fixed region per decode slot,
+**slot-contiguous, head-major** layout: one fixed region per decode slot,
 
-    k, v : [num_layers, num_slots, max_len, num_kv_heads, head_dim]   (bf16)
+    k, v : [num_layers, num_slots, num_kv_heads, max_len, head_dim]   (bf16)
 
 which is exactly a paged cache whose per-slot block table is the identity —
-``max_len/page_size`` pages per slot, page p of slot b at
-``k[:, b, p*page_size:(p+1)*page_size]``. This buys:
+``max_len/page_size`` pages per (slot, head), page p of slot b head h at
+``k[:, b, h, p*page_size:(p+1)*page_size]``. This buys:
 
 - static shapes (XLA compiles one decode program, no re-specialization),
 - in-place row writes via scatter-at-index (donated buffers, zero copies),
 - attention that reads the cache *in place* (no gather of pages, no repeat_kv
   materialization — see ops/attention.py),
-- a pages **view** for the Pallas ragged-attention kernel without relayout.
+- **head-contiguous K/V streams**: the Pallas decode kernel DMAs one
+  ``[Hkv, chunk, D]`` block per grid step and issues a single batched MXU
+  matmul over all heads — no in-kernel transpose, no per-head small-matmul
+  loop (the [S, Hkv, D] row-major alternative forces one or the other).
 
 Raggedness (every slot at a different sequence length) is expressed by a
 ``lengths[num_slots]`` vector and masking, not by dynamic shapes.
@@ -33,7 +36,7 @@ from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
 def init_cache(cfg: ModelConfig, num_slots: int, max_len: int,
                dtype=jnp.bfloat16) -> dict:
     """Allocate the decode cache. Leaves carry a leading [L] axis for lax.scan."""
-    shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, num_slots, cfg.num_kv_heads, max_len, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
@@ -51,11 +54,14 @@ def write_prompt(cache_l: dict, slot: jnp.ndarray, k: jnp.ndarray,
                  v: jnp.ndarray) -> dict:
     """Write a prefilled prompt's K/V into one slot (single layer slice).
 
-    cache_l: {'k','v': [num_slots, max_len, Hkv, D]}; k/v: [1, T, Hkv, D];
+    cache_l: {'k','v': [num_slots, Hkv, max_len, D]}; k/v: [1, T, Hkv, D];
     slot: scalar int. Writes rows [0, T) of the slot (padded tail rows beyond the
     true length hold garbage — decode masks by length, so they are never read).
+    The [T, Hkv] → [Hkv, T] transpose happens once here, at prefill, so every
+    decode step reads head-contiguous streams.
     """
-    k3, v3 = k[0], v[0]  # [T, Hkv, D]
+    k3 = jnp.swapaxes(k[0], 0, 1)  # [Hkv, T, D]
+    v3 = jnp.swapaxes(v[0], 0, 1)
     start = (slot, jnp.zeros_like(slot), jnp.zeros_like(slot),
              jnp.zeros_like(slot))
     return {
@@ -68,25 +74,28 @@ def write_token(cache_l: dict, lengths: jnp.ndarray, k: jnp.ndarray,
                 v: jnp.ndarray) -> dict:
     """Scatter one new token per slot at its current length (single layer slice).
 
-    cache_l: {'k','v': [B, S, Hkv, D]}; lengths: [B]; k/v: [B, 1, Hkv, D].
+    cache_l: {'k','v': [B, Hkv, S, D]}; lengths: [B]; k/v: [B, 1, Hkv, D].
     """
     B = k.shape[0]
     rows = jnp.arange(B)
+    # Advanced indexing at axes (0, 2) with the head slice between them yields
+    # [B, Hkv, D] targets — exactly the incoming token's shape.
     return {
-        "k": cache_l["k"].at[rows, lengths].set(k[:, 0]),
-        "v": cache_l["v"].at[rows, lengths].set(v[:, 0]),
+        "k": cache_l["k"].at[rows, :, lengths].set(k[:, 0]),
+        "v": cache_l["v"].at[rows, :, lengths].set(v[:, 0]),
     }
 
 
 def pages_view(cache: dict, page_size: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Reinterpret the slot cache as pages: [L, slots*pages_per_slot, page, H, D].
+    """Reinterpret the cache as pages: [L, slots*heads*pages_per_stream, page, D].
 
-    Zero-copy reshape (the slot dimension is contiguous); the implied block table
-    of slot b is ``b*pages_per_slot + arange(pages_per_slot)``. Used by the Pallas
-    paged-attention kernel and by future true-paged allocation.
+    Zero-copy reshape (the per-(slot, head) stream is contiguous); the implied
+    block table of (slot b, head h) is ``(b*Hkv + h)*pages_per_stream +
+    arange(pages_per_stream)``. Used by the Pallas paged-attention kernel and by
+    future true-paged allocation.
     """
-    L, B, S, H, D = cache["k"].shape
+    L, B, H, S, D = cache["k"].shape
     assert S % page_size == 0, (S, page_size)
-    n = B * (S // page_size)
-    return (cache["k"].reshape(L, n, page_size, H, D),
-            cache["v"].reshape(L, n, page_size, H, D))
+    n = B * H * (S // page_size)
+    return (cache["k"].reshape(L, n, page_size, D),
+            cache["v"].reshape(L, n, page_size, D))

@@ -157,8 +157,11 @@ class RouterHandler(BaseHTTPRequestHandler):
                     # delimits the body.
                     self.send_header("Connection", "close")
                     self.end_headers()
+                    # read1 returns as soon as ANY bytes arrive — read(4096)
+                    # would buffer whole events and defeat token streaming.
+                    read1 = getattr(resp, "read1", None) or resp.read
                     while True:
-                        chunk = resp.read(4096)
+                        chunk = read1(4096)
                         if not chunk:
                             break
                         self.wfile.write(chunk)

@@ -11,7 +11,7 @@ from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
 def test_shapes_and_bytes():
     cfg = tiny_qwen3()
     cache = kvc.init_cache(cfg, num_slots=4, max_len=32, dtype=jnp.bfloat16)
-    assert cache["k"].shape == (cfg.num_layers, 4, 32, cfg.num_kv_heads,
+    assert cache["k"].shape == (cfg.num_layers, 4, cfg.num_kv_heads, 32,
                                 cfg.head_dim)
     expect = 2 * np.prod(cache["k"].shape) * 2
     assert kvc.cache_bytes(cfg, 4, 32) == expect
@@ -28,8 +28,11 @@ def test_write_prompt_then_tokens_roundtrip():
                     jnp.float32)
     v = k * 2
     layer = kvc.write_prompt(layer, jnp.int32(2), k, v)
-    np.testing.assert_allclose(np.asarray(layer["k"][2, :T]), np.asarray(k[0]))
-    np.testing.assert_allclose(np.asarray(layer["v"][2, :T]), np.asarray(v[0]))
+    # head-major layout: compare against the [Hkv, T, D] transpose
+    np.testing.assert_allclose(np.asarray(layer["k"][2, :, :T]),
+                               np.asarray(jnp.swapaxes(k[0], 0, 1)))
+    np.testing.assert_allclose(np.asarray(layer["v"][2, :, :T]),
+                               np.asarray(jnp.swapaxes(v[0], 0, 1)))
     # other slots untouched
     assert float(jnp.abs(layer["k"][0]).sum()) == 0.0
 
@@ -38,19 +41,22 @@ def test_write_prompt_then_tokens_roundtrip():
     k1 = jnp.asarray(rng.normal(size=(4, 1, cfg.num_kv_heads, cfg.head_dim)),
                      jnp.float32)
     layer = kvc.write_token(layer, lengths, k1, k1 * 3)
-    np.testing.assert_allclose(np.asarray(layer["k"][2, T]), np.asarray(k1[2, 0]))
-    np.testing.assert_allclose(np.asarray(layer["v"][2, T]),
+    np.testing.assert_allclose(np.asarray(layer["k"][2, :, T]),
+                               np.asarray(k1[2, 0]))
+    np.testing.assert_allclose(np.asarray(layer["v"][2, :, T]),
                                np.asarray(k1[2, 0] * 3))
     # slot 2's prompt rows survive the token write
-    np.testing.assert_allclose(np.asarray(layer["k"][2, :T]), np.asarray(k[0]))
+    np.testing.assert_allclose(np.asarray(layer["k"][2, :, :T]),
+                               np.asarray(jnp.swapaxes(k[0], 0, 1)))
 
 
 def test_pages_view_is_reshape():
     cfg = tiny_qwen3()
     cache = kvc.init_cache(cfg, 2, 32, dtype=jnp.float32)
-    cache["k"] = cache["k"].at[:, 1, 17].set(1.0)
+    cache["k"] = cache["k"].at[:, 1, 0, 17].set(1.0)
     kp, vp = kvc.pages_view(cache, page_size=16)
     L = cfg.num_layers
-    assert kp.shape == (L, 2 * 2, 16, cfg.num_kv_heads, cfg.head_dim)
-    # slot 1, row 17 == page (1*2 + 1), row 1
-    assert float(kp[0, 3, 1].sum()) > 0
+    H = cfg.num_kv_heads
+    assert kp.shape == (L, 2 * H * 2, 16, cfg.head_dim)
+    # slot 1, head 0, row 17 == stream (1*H + 0), page 1, row 1
+    assert float(kp[0, (1 * H + 0) * 2 + 1, 1].sum()) > 0

@@ -1,0 +1,113 @@
+"""Pallas decode-attention kernel parity tests (interpret mode on CPU).
+
+The kernel is the framework's hot loop (SURVEY.md §7 hard part #1); these
+tests pin it bit-for-bit (fp32 tolerance) against the XLA reference
+implementation in ops/attention.py across raggedness, GQA grouping, and
+multi-chunk streaming."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.ops.attention import decode_attend
+from aws_k8s_ansible_provisioner_tpu.ops.pallas_attention import (
+    decode_attend_pallas,
+)
+
+
+def _inputs(B=4, S=128, Hq=4, Hkv=2, D=32, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(ks[0], (B, 1, Hq, D), dtype)
+    k = jax.random.normal(ks[1], (B, Hkv, S, D), dtype)
+    v = jax.random.normal(ks[2], (B, Hkv, S, D), dtype)
+    lengths = jax.random.randint(ks[3], (B,), 1, S + 1)
+    return q, k, v, lengths
+
+
+@pytest.mark.parametrize("chunk", [32, 64, 128])
+def test_parity_vs_xla_across_chunks(chunk):
+    q, k, v, lengths = _inputs()
+    ref = decode_attend(q, k, v, lengths)
+    out = decode_attend_pallas(q, k, v, lengths, chunk=chunk, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_parity_gqa_grouping():
+    # Qwen3-0.6B shape family: 16 query heads over 8 KV heads (G=2).
+    q, k, v, lengths = _inputs(B=2, S=64, Hq=16, Hkv=8, D=64)
+    ref = decode_attend(q, k, v, lengths)
+    out = decode_attend_pallas(q, k, v, lengths, chunk=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_parity_mha_no_grouping():
+    q, k, v, lengths = _inputs(B=2, S=64, Hq=4, Hkv=4, D=16)
+    ref = decode_attend(q, k, v, lengths)
+    out = decode_attend_pallas(q, k, v, lengths, chunk=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_extremes():
+    # length=1 (just-prefilled single token) and length=S (full window)
+    q, k, v, _ = _inputs(B=3, S=96, Hq=4, Hkv=2, D=32)
+    lengths = jnp.array([1, 96, 37])
+    ref = decode_attend(q, k, v, lengths)
+    out = decode_attend_pallas(q, k, v, lengths, chunk=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_empty_slot_yields_finite_values():
+    # Inactive slots (length 0) must produce garbage-but-finite output, never
+    # NaN that could poison debugging or downstream reductions.
+    q, k, v, _ = _inputs(B=2, S=64, Hq=4, Hkv=2, D=32)
+    lengths = jnp.array([0, 10])
+    out = decode_attend_pallas(q, k, v, lengths, chunk=32, interpret=True)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_masking_ignores_stale_cache_rows():
+    # Rows beyond `length` must not influence the output: poison them.
+    q, k, v, lengths = _inputs(B=2, S=64, Hq=4, Hkv=2, D=32)
+    lengths = jnp.array([5, 17])
+    valid = jnp.arange(64)[None, None, :, None] < lengths[:, None, None, None]
+    k_poison = jnp.where(valid, k, 1e4)
+    v_poison = jnp.where(valid, v, -1e4)
+    out = decode_attend_pallas(q, k, v, lengths, chunk=32, interpret=True)
+    out_p = decode_attend_pallas(q, k_poison, v_poison, lengths, chunk=32,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_p),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_inputs_fp32_accumulation():
+    q, k, v, lengths = _inputs(B=2, S=64, Hq=8, Hkv=4, D=64,
+                               dtype=jnp.bfloat16)
+    ref = decode_attend(q, k, v, lengths)
+    out = decode_attend_pallas(q, k, v, lengths, chunk=32, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_resolve_impl_auto_is_xla_on_cpu():
+    from aws_k8s_ansible_provisioner_tpu.ops.attention import resolve_impl
+
+    assert resolve_impl("auto") in ("xla", "pallas")
+    assert resolve_impl("xla") == "xla"
+    assert resolve_impl("pallas") == "pallas"
+
+
+def test_non_divisible_cache_len_picks_divisor_chunk():
+    # e.g. --max-cache-len 96 with default chunk 256: must not crash
+    q, k, v, _ = _inputs(B=2, S=96, Hq=4, Hkv=2, D=32)
+    lengths = jnp.array([40, 96])
+    ref = decode_attend(q, k, v, lengths)
+    out = decode_attend_pallas(q, k, v, lengths, chunk=256, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
