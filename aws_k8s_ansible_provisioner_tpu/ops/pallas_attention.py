@@ -13,9 +13,9 @@ Raggedness (every slot at a different length) is handled two ways:
 - *DMA skipping*: the chunk index_map clamps dead chunks (beyond the slot's
   length) to the last live chunk — Pallas skips re-fetch when a block index
   repeats, so a slot at length 130 reads ~2 chunks of cache, not S/CHUNK.
-  With the identity block table of the slot-contiguous cache
-  (serving/kv_cache.py pages_view), this IS paged attention: chunk c of slot b
-  is page ``b*pages_per_slot + c``.
+  With the identity block table of the slot-contiguous head-major cache
+  (serving/kv_cache.py pages_view), this IS paged attention: chunk c of
+  (slot b, head h) is page ``(b*Hkv + h)*pages_per_stream + c``.
 
 GQA grouping stays in-kernel: per KV head h, the G=Hq/Hkv query rows attend to
 one [CHUNK, D] K/V stream — no repeat_kv copy ever exists (the same design as

@@ -1,0 +1,17 @@
+"""Host-side runtime core: slot allocation, admission queue, page accounting.
+
+The compute path is JAX/XLA; this package is the native-runtime half the task
+calls for — the C++ scheduler/allocator machinery that the reference stack
+gets from inside its external vLLM container (SURVEY.md §2.2 row 1). The
+authoritative implementation is ``native/runtime/runtime.cc`` (C ABI, loaded
+via ctypes); ``scheduler.PyScheduler`` is the behavior-identical pure-Python
+fallback used when the shared library hasn't been built.
+"""
+
+from aws_k8s_ansible_provisioner_tpu.runtime.scheduler import (  # noqa: F401
+    NativeScheduler,
+    PyScheduler,
+    SchedulerStats,
+    make_scheduler,
+    native_available,
+)

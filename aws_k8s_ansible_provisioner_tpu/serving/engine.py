@@ -152,7 +152,13 @@ class Engine:
         self.eos_token_id = cfg.eos_token_id if eos_token_id is None \
             else eos_token_id
         self.num_slots = serving.max_decode_slots
-        self.max_len = serving.max_cache_len
+        # Round the cache window up to a 256 multiple: the Pallas decode
+        # kernel streams the cache in chunks that must divide the window, and
+        # an awkward length (e.g. 509) would degrade its chunk size to the
+        # largest divisor — potentially 1. A slightly larger cache is the
+        # right trade.
+        self.max_len = -(-serving.max_cache_len // 256) * 256 \
+            if serving.max_cache_len > 256 else serving.max_cache_len
         self.buckets = tuple(b for b in serving.prefill_buckets
                              if b <= self.max_len)
         dtype = jnp.bfloat16 if serving.dtype == "bfloat16" else jnp.float32
@@ -167,10 +173,23 @@ class Engine:
         self.top_ks = np.zeros(self.num_slots, np.int32)
         self.top_ps = np.ones(self.num_slots, np.float32)
         self.slot_req: List[Optional[Request]] = [None] * self.num_slots
-        self.pending: Deque[Request] = collections.deque()
+        # Admission queue + slot lifecycle live in the runtime core (native
+        # C++ when built — see native/runtime; Python fallback otherwise).
+        # The engine holds only the id -> Request map for queued requests.
+        from aws_k8s_ansible_provisioner_tpu.runtime import make_scheduler
+
+        self.sched = make_scheduler(self.num_slots, self.max_len,
+                                    serving.page_size)
+        self._queued: dict = {}
         self._lock = threading.Lock()
         self._work_event = threading.Event()
         self._tok_times: Deque = collections.deque(maxlen=50)
+
+    @property
+    def pending(self):
+        """Back-compat view of the scheduler queue (len / truthiness)."""
+        with self._lock:
+            return list(self._queued.values())
 
     # -- submission ---------------------------------------------------------
 
@@ -186,8 +205,9 @@ class Engine:
         if req.max_tokens > budget:
             req.max_tokens = max(1, budget)
         with self._lock:
-            self.pending.append(req)
-            self.metrics.queue_depth.set(len(self.pending))
+            self._queued[req.id] = req
+            self.sched.submit(req.id, len(req.prompt_ids), req.max_tokens)
+            self.metrics.queue_depth.set(self.sched.stats().queue_depth)
         self._work_event.set()
         return req
 
@@ -216,6 +236,7 @@ class Engine:
     def cancel(self, req: Request):
         """Mark a request cancelled; its slot frees on the next engine step."""
         req.cancelled = True
+        self.sched.cancel(req.id)
         self._work_event.set()
 
     def step(self) -> bool:
@@ -226,20 +247,39 @@ class Engine:
             if r is not None and r.cancelled:
                 r.finish_reason = "cancelled"
                 self._finish(slot)
-        with self._lock:
-            req = None
-            free = self._free_slots()
-            while self.pending and free:
-                cand = self.pending.popleft()
-                self.metrics.queue_depth.set(len(self.pending))
-                if cand.cancelled:
+        # Admission decisions come from the runtime core (FCFS; skips
+        # cancelled-in-queue requests, surfacing them for client notification).
+        while True:
+            action = self.sched.pop_admission()
+            if action is None:
+                break
+            if action[0] == "cancelled":
+                with self._lock:
+                    cand = self._queued.pop(action[1], None)
+                self.metrics.queue_depth.set(self.sched.stats().queue_depth)
+                if cand is not None:
                     cand.finish_reason = "cancelled"
                     cand.out_queue.put(None)
-                    continue
-                req, slot = cand, free[0]
-                break
-        if req is not None:
-            self._do_prefill(req, slot)
+                continue
+            _, rid, slot = action
+            with self._lock:
+                req = self._queued.pop(rid, None)
+            self.metrics.queue_depth.set(self.sched.stats().queue_depth)
+            if req is None:  # should not happen; free the slot defensively
+                self.sched.release(slot)
+                continue
+            try:
+                self._do_prefill(req, slot)
+            except Exception:
+                # The slot was assigned by the scheduler but slot_req[slot] is
+                # only set on success — release it and notify the client here,
+                # or the capacity leaks and the waiter hangs (run_forever's
+                # _fail_all can't see either).
+                self.sched.release(slot)
+                req.finish_reason = "error"
+                self.metrics.mark_request("error", 0.0)
+                req.out_queue.put(None)
+                raise
             return True
         if self._active_slots():
             self._do_decode()
@@ -267,6 +307,7 @@ class Engine:
         self.temps[slot] = req.temperature
         self.top_ks[slot] = req.top_k
         self.top_ps[slot] = req.top_p
+        self.sched.note_prefill(slot, len(ids))
         self.metrics.active_requests.set(len(self._active_slots()))
         self._emit(slot, token)
 
@@ -278,8 +319,8 @@ class Engine:
         # isn't taxed. Under saturation (pending but no free slot) a prefill
         # is impossible anyway, so keep the fused horizon — dropping to
         # horizon=1 there would disable the amortization exactly at peak load.
-        with self._lock:
-            prefill_possible = bool(self.pending) and bool(self._free_slots())
+        st = self.sched.stats()
+        prefill_possible = st.queue_depth > 0 and st.active_slots < st.num_slots
         horizon = 1 if prefill_possible else max(1, self.serving.decode_horizon)
         self.cache, out = decode_steps(
             self.cfg, horizon, self.params, self.cache,
@@ -295,6 +336,7 @@ class Engine:
                 if self.slot_req[slot] is None:
                     continue  # finished earlier in this horizon
                 self.lengths[slot] += 1
+                self.sched.note_decode(slot, 1)
                 self._emit(slot, int(out[s, slot]))
                 emitted += 1
         self._tok_times.append((t0, emitted))
@@ -329,6 +371,7 @@ class Engine:
         self.slot_req[slot] = None
         self.lengths[slot] = 0
         self.temps[slot] = 0.0
+        self.sched.release(slot)
         self.metrics.active_requests.set(len(self._active_slots()))
         req.out_queue.put(None)  # sentinel: done
 
@@ -365,12 +408,30 @@ class Engine:
                 r.finish_reason = "error"
                 self._finish(slot)
         with self._lock:
-            while self.pending:
-                r = self.pending.popleft()
-                r.finish_reason = "error"
-                self.metrics.mark_request("error", 0.0)
-                r.out_queue.put(None)
-            self.metrics.queue_depth.set(0)
+            queued, self._queued = self._queued, {}
+        for r in queued.values():
+            self.sched.cancel(r.id)
+            r.finish_reason = "error"
+            self.metrics.mark_request("error", 0.0)
+            r.out_queue.put(None)
+        # Drain the scheduler's cancelled-in-queue notifications so its queue
+        # empties (the Request objects were already notified above). A request
+        # submitted AFTER the failure may interleave here and surface as an
+        # admission: it is healthy work, not part of the failure — requeue it
+        # for the next step and stop draining (everything behind it is new).
+        while True:
+            action = self.sched.pop_admission()
+            if action is None:
+                break
+            if action[0] == "admit":
+                _, rid, slot = action
+                self.sched.release(slot)
+                with self._lock:
+                    r = self._queued.get(rid)
+                if r is not None:
+                    self.sched.submit(rid, len(r.prompt_ids), r.max_tokens)
+                break
+        self.metrics.queue_depth.set(self.sched.stats().queue_depth)
 
     def warmup(self):
         """Pre-compile every program (each prefill bucket + decode) so the first

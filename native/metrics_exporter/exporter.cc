@@ -1,0 +1,148 @@
+/* TPU metrics exporter (native): Prometheus text endpoint for per-chip TPU
+ * telemetry.
+ *
+ * Native parallel of the DCGM exporter role in the reference stack (Go/C++
+ * component scraped on a named port, reference kubernetes-single-node.yaml:
+ * 480-504 and otel-observability-setup.yaml:393-468). Output format is
+ * byte-compatible with the Python module
+ * aws_k8s_ansible_provisioner_tpu/k8s/metrics_exporter.py (same families,
+ * same labels) so either binary can back the DaemonSet: this one is the
+ * minimal-footprint mode (no Python/JAX in the container, ~100 KB static
+ * binary, near-zero RSS), the Python one additionally reads HBM telemetry
+ * through a live JAX runtime.
+ *
+ * Plain POSIX sockets; single-threaded accept loop (a scrape every 5s is the
+ * whole load profile). Build: `make -C native exporter`.
+ */
+
+#include <arpa/inet.h>
+#include <dirent.h>
+#include <netinet/in.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <string>
+#include <vector>
+
+namespace {
+
+// Chip index from a device node name: "accel3" -> "3", "7" -> "7", "accel"
+// -> "0". Matches device_plugin._chip_index so dashboards agree on identity.
+std::string ChipIndex(const std::string& name) {
+  std::string digits;
+  for (char c : name) {
+    if (c >= '0' && c <= '9') digits.push_back(c);
+  }
+  return digits.empty() ? "0" : digits;
+}
+
+std::vector<std::string> DiscoverChips() {
+  std::vector<std::string> chips;
+  if (DIR* d = opendir("/dev")) {
+    while (dirent* e = readdir(d)) {
+      if (strncmp(e->d_name, "accel", 5) == 0) chips.push_back(e->d_name);
+    }
+    closedir(d);
+  }
+  if (chips.empty()) {
+    if (DIR* d = opendir("/dev/vfio")) {
+      while (dirent* e = readdir(d)) {
+        std::string n = e->d_name;
+        if (!n.empty() && n.find_first_not_of("0123456789") == std::string::npos)
+          chips.push_back(n);
+      }
+      closedir(d);
+    }
+  }
+  return chips;
+}
+
+std::string RenderMetrics() {
+  std::vector<std::string> chips = DiscoverChips();
+  std::string out;
+  out += "# HELP tpu_exporter_up TPU metrics exporter liveness\n";
+  out += "# TYPE tpu_exporter_up gauge\n";
+  out += "tpu_exporter_up 1\n";
+  out += "# HELP tpu_chips_total TPU chips visible on this host\n";
+  out += "# TYPE tpu_chips_total gauge\n";
+  out += "tpu_chips_total " + std::to_string(chips.size()) + "\n";
+  struct Family { const char* name; const char* help; };
+  const Family families[] = {
+      {"tpu_hbm_used_bytes", "HBM bytes in use"},
+      {"tpu_hbm_capacity_bytes", "HBM capacity in bytes"},
+      {"tpu_duty_cycle_percent", "Accelerator busy percent"},
+      {"tpu_tensorcore_utilization_percent", "MXU utilization percent"},
+  };
+  for (const Family& f : families) {
+    out += std::string("# HELP ") + f.name + " " + f.help + "\n";
+    out += std::string("# TYPE ") + f.name + " gauge\n";
+    for (const std::string& chip : chips) {
+      // Device-node enumeration only (runtime-independent mode): gauges are 0,
+      // which keeps the scrape target and chip inventory alive; the Python
+      // exporter fills real HBM numbers when it owns the runtime.
+      out += std::string(f.name) + "{chip=\"" + ChipIndex(chip) +
+             "\",kind=\"tpu\"} 0\n";
+    }
+  }
+  return out;
+}
+
+void Respond(int fd, const char* status, const char* ctype,
+             const std::string& body) {
+  std::string resp = std::string("HTTP/1.1 ") + status +
+                     "\r\nContent-Type: " + ctype +
+                     "\r\nContent-Length: " + std::to_string(body.size()) +
+                     "\r\nConnection: close\r\n\r\n" + body;
+  size_t off = 0;
+  while (off < resp.size()) {
+    ssize_t n = write(fd, resp.data() + off, resp.size() - off);
+    if (n <= 0) break;
+    off += static_cast<size_t>(n);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 9400;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    if (strcmp(argv[i], "--port") == 0) port = atoi(argv[i + 1]);
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(srv, 16) != 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  fprintf(stderr, "tpu-metrics-exporter (native) on :%d/metrics\n", port);
+
+  for (;;) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    char buf[2048];
+    ssize_t n = read(fd, buf, sizeof(buf) - 1);
+    if (n > 0) {
+      buf[n] = '\0';
+      if (strstr(buf, "GET /metrics") == buf) {
+        Respond(fd, "200 OK", "text/plain; version=0.0.4", RenderMetrics());
+      } else if (strstr(buf, "GET /health") == buf) {
+        Respond(fd, "200 OK", "application/json", "{\"status\": \"ok\"}");
+      } else {
+        Respond(fd, "404 Not Found", "text/plain", "not found\n");
+      }
+    }
+    close(fd);
+  }
+}

@@ -1,0 +1,170 @@
+/* Implementation of the TPU serving runtime core (see runtime.h). */
+
+#include "runtime.h"
+
+#include <deque>
+#include <mutex>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Pending {
+  int64_t req_id;
+  int32_t prompt_len;
+  int32_t max_tokens;
+};
+
+}  // namespace
+
+struct ts_runtime {
+  std::mutex mu;
+  int32_t num_slots = 0;
+  int32_t max_len = 0;
+  int32_t page_size = 0;
+
+  std::deque<Pending> queue;
+  std::unordered_set<int64_t> cancelled_pending;
+
+  // Per-slot state: -1 = free, else req_id.
+  std::vector<int64_t> slot_req;
+  std::vector<int32_t> slot_len;
+  std::vector<uint8_t> slot_cancelled;
+
+  int64_t admitted_total = 0;
+  int64_t finished_total = 0;
+  int64_t cancelled_total = 0;
+};
+
+extern "C" {
+
+ts_runtime* ts_create(int32_t num_slots, int32_t max_len, int32_t page_size) {
+  if (num_slots <= 0 || max_len <= 0 || page_size <= 0) return nullptr;
+  auto* rt = new ts_runtime();
+  rt->num_slots = num_slots;
+  rt->max_len = max_len;
+  rt->page_size = page_size;
+  rt->slot_req.assign(num_slots, -1);
+  rt->slot_len.assign(num_slots, 0);
+  rt->slot_cancelled.assign(num_slots, 0);
+  return rt;
+}
+
+void ts_destroy(ts_runtime* rt) { delete rt; }
+
+int32_t ts_submit(ts_runtime* rt, int64_t req_id, int32_t prompt_len,
+                  int32_t max_tokens) {
+  if (prompt_len < 0 || prompt_len + 1 > rt->max_len) return -1;
+  std::lock_guard<std::mutex> lock(rt->mu);
+  rt->queue.push_back(Pending{req_id, prompt_len, max_tokens});
+  return 0;
+}
+
+int32_t ts_cancel(ts_runtime* rt, int64_t req_id) {
+  std::lock_guard<std::mutex> lock(rt->mu);
+  for (const auto& p : rt->queue) {
+    if (p.req_id == req_id) {
+      rt->cancelled_pending.insert(req_id);
+      return 1;
+    }
+  }
+  for (int32_t s = 0; s < rt->num_slots; ++s) {
+    if (rt->slot_req[s] == req_id) {
+      rt->slot_cancelled[s] = 1;
+      return 2;
+    }
+  }
+  return 0;
+}
+
+int32_t ts_pop_admission(ts_runtime* rt, int64_t* req_id, int32_t* slot,
+                         int64_t* cancelled_id, int32_t* n_cancelled) {
+  std::lock_guard<std::mutex> lock(rt->mu);
+  *n_cancelled = 0;
+  int32_t free_slot = -1;
+  for (int32_t s = 0; s < rt->num_slots; ++s) {
+    if (rt->slot_req[s] < 0) { free_slot = s; break; }
+  }
+  while (!rt->queue.empty()) {
+    Pending p = rt->queue.front();
+    auto it = rt->cancelled_pending.find(p.req_id);
+    if (it != rt->cancelled_pending.end()) {
+      // Report one cancelled-in-queue request per call so the caller can
+      // notify its waiter; remaining ones surface on subsequent calls.
+      rt->queue.pop_front();
+      rt->cancelled_pending.erase(it);
+      rt->cancelled_total += 1;
+      *cancelled_id = p.req_id;
+      *n_cancelled = 1;
+      return 0;
+    }
+    if (free_slot < 0) return 0;  // queue non-empty but no capacity
+    rt->queue.pop_front();
+    rt->slot_req[free_slot] = p.req_id;
+    rt->slot_len[free_slot] = 0;
+    rt->slot_cancelled[free_slot] = 0;
+    rt->admitted_total += 1;
+    *req_id = p.req_id;
+    *slot = free_slot;
+    return 1;
+  }
+  return 0;
+}
+
+void ts_note_prefill(ts_runtime* rt, int32_t slot, int32_t length) {
+  std::lock_guard<std::mutex> lock(rt->mu);
+  if (slot >= 0 && slot < rt->num_slots) rt->slot_len[slot] = length;
+}
+
+void ts_note_decode(ts_runtime* rt, int32_t slot, int32_t n) {
+  std::lock_guard<std::mutex> lock(rt->mu);
+  if (slot >= 0 && slot < rt->num_slots) {
+    rt->slot_len[slot] += n;
+    if (rt->slot_len[slot] > rt->max_len) rt->slot_len[slot] = rt->max_len;
+  }
+}
+
+int64_t ts_release(ts_runtime* rt, int32_t slot) {
+  std::lock_guard<std::mutex> lock(rt->mu);
+  if (slot < 0 || slot >= rt->num_slots || rt->slot_req[slot] < 0) return -1;
+  int64_t id = rt->slot_req[slot];
+  rt->slot_req[slot] = -1;
+  rt->slot_len[slot] = 0;
+  if (rt->slot_cancelled[slot]) rt->cancelled_total += 1; else rt->finished_total += 1;
+  rt->slot_cancelled[slot] = 0;
+  return id;
+}
+
+int32_t ts_next_cancelled_slot(ts_runtime* rt) {
+  std::lock_guard<std::mutex> lock(rt->mu);
+  for (int32_t s = 0; s < rt->num_slots; ++s) {
+    if (rt->slot_req[s] >= 0 && rt->slot_cancelled[s]) return s;
+  }
+  return -1;
+}
+
+void ts_get_stats(ts_runtime* rt, ts_stats* out) {
+  std::lock_guard<std::mutex> lock(rt->mu);
+  out->num_slots = rt->num_slots;
+  int32_t active = 0;
+  int64_t pages_used = 0;
+  const int64_t pages_per_slot =
+      (rt->max_len + rt->page_size - 1) / rt->page_size;
+  for (int32_t s = 0; s < rt->num_slots; ++s) {
+    if (rt->slot_req[s] >= 0) {
+      ++active;
+      pages_used +=
+          (rt->slot_len[s] + rt->page_size - 1) / rt->page_size;
+    }
+  }
+  out->active_slots = active;
+  out->queue_depth = static_cast<int32_t>(rt->queue.size());
+  out->pages_total = pages_per_slot * rt->num_slots;
+  out->pages_in_use = pages_used;
+  out->admitted_total = rt->admitted_total;
+  out->finished_total = rt->finished_total;
+  out->cancelled_total = rt->cancelled_total;
+}
+
+}  // extern "C"

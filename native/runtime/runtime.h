@@ -1,0 +1,82 @@
+/* TPU serving runtime core: slot allocator + admission queue + page accounting.
+ *
+ * Native (C++) equivalent of the scheduler/allocator machinery that lives in
+ * C++ inside the reference stack's external vLLM engine (SURVEY.md §2.2 row 1:
+ * "continuous batching, paged KV cache"). The JAX engine keeps the compute
+ * path; this library owns the host-side bookkeeping hot path:
+ *   - FCFS admission queue with cancellation,
+ *   - decode-slot lifecycle (acquire on prefill, release on finish),
+ *   - KV page accounting for the slot-contiguous cache layout
+ *     (serving/kv_cache.py): pages_per_slot = max_len / page_size, usage
+ *     derived from per-slot lengths.
+ *
+ * Exposed as a C ABI for ctypes binding (no pybind11 in the image — see
+ * aws_k8s_ansible_provisioner_tpu/runtime/native.py). Thread-safe: every call
+ * takes the runtime mutex; the Python engine may submit from HTTP threads
+ * while the scheduler thread pops admissions.
+ */
+#ifndef TPU_SERVE_RUNTIME_H_
+#define TPU_SERVE_RUNTIME_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct ts_runtime ts_runtime;
+
+typedef struct ts_stats {
+  int32_t num_slots;
+  int32_t active_slots;
+  int32_t queue_depth;
+  int64_t pages_total;
+  int64_t pages_in_use;
+  int64_t admitted_total;
+  int64_t finished_total;
+  int64_t cancelled_total;
+} ts_stats;
+
+/* Create a runtime for `num_slots` decode slots, each holding `max_len`
+ * tokens of KV in pages of `page_size` tokens. Returns NULL on bad args. */
+ts_runtime* ts_create(int32_t num_slots, int32_t max_len, int32_t page_size);
+void ts_destroy(ts_runtime* rt);
+
+/* Enqueue request `req_id` (caller-assigned, unique) with a `prompt_len`-token
+ * prompt and a `max_tokens` generation budget. Returns 0, or -1 if the prompt
+ * can never fit a slot (prompt_len + 1 > max_len). */
+int32_t ts_submit(ts_runtime* rt, int64_t req_id, int32_t prompt_len,
+                  int32_t max_tokens);
+
+/* Cancel a request: removed from the queue if still pending (returns 1);
+ * marked for reap if running in a slot (returns 2); unknown id returns 0. */
+int32_t ts_cancel(ts_runtime* rt, int64_t req_id);
+
+/* Pop the next admission decision: if a request is pending and a slot is
+ * free, assigns the slot (FCFS) and writes req_id/slot. Returns 1 on an
+ * admission, 0 if nothing to admit. Cancelled-while-pending requests are
+ * skipped and written to `cancelled_id` (one per call, check *n_cancelled). */
+int32_t ts_pop_admission(ts_runtime* rt, int64_t* req_id, int32_t* slot,
+                         int64_t* cancelled_id, int32_t* n_cancelled);
+
+/* Record prefill completion for `slot` at `length` tokens (prompt + first
+ * generated token). */
+void ts_note_prefill(ts_runtime* rt, int32_t slot, int32_t length);
+
+/* Record one decode step for `slot` (length += n). */
+void ts_note_decode(ts_runtime* rt, int32_t slot, int32_t n);
+
+/* Release `slot` (request finished/cancelled). Returns the req_id that held
+ * it, or -1 if the slot was already free. */
+int64_t ts_release(ts_runtime* rt, int32_t slot);
+
+/* Next slot marked cancelled-while-running, or -1. (Engine reaps these.) */
+int32_t ts_next_cancelled_slot(ts_runtime* rt);
+
+void ts_get_stats(ts_runtime* rt, ts_stats* out);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPU_SERVE_RUNTIME_H_ */

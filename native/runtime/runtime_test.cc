@@ -1,0 +1,62 @@
+/* C++ smoke test for the runtime core: admission FCFS, cancellation paths,
+ * slot lifecycle, page accounting. Run via `make -C native test`. */
+
+#include "runtime.h"
+
+#include <assert.h>
+#include <stdio.h>
+
+int main() {
+  ts_runtime* rt = ts_create(2, 64, 16);
+  assert(rt != nullptr);
+  assert(ts_create(0, 64, 16) == nullptr);
+
+  // Oversized prompt rejected.
+  assert(ts_submit(rt, 100, 64, 8) == -1);
+  assert(ts_submit(rt, 1, 10, 8) == 0);
+  assert(ts_submit(rt, 2, 10, 8) == 0);
+  assert(ts_submit(rt, 3, 10, 8) == 0);
+
+  int64_t rid = -1, cid = -1;
+  int32_t slot = -1, ncan = 0;
+
+  // FCFS over 2 slots: ids 1 and 2 admitted; 3 waits.
+  assert(ts_pop_admission(rt, &rid, &slot, &cid, &ncan) == 1);
+  assert(rid == 1 && slot == 0 && ncan == 0);
+  assert(ts_pop_admission(rt, &rid, &slot, &cid, &ncan) == 1);
+  assert(rid == 2 && slot == 1);
+  assert(ts_pop_admission(rt, &rid, &slot, &cid, &ncan) == 0 && ncan == 0);
+
+  ts_note_prefill(rt, 0, 11);
+  ts_note_decode(rt, 0, 1);
+  ts_stats st;
+  ts_get_stats(rt, &st);
+  assert(st.active_slots == 2 && st.queue_depth == 1);
+  assert(st.pages_total == 2 * (64 / 16));
+  assert(st.pages_in_use == 1 /* ceil(12/16) */);
+
+  // Cancel the queued request: surfaced via pop, no admission.
+  assert(ts_cancel(rt, 3) == 1);
+  assert(ts_pop_admission(rt, &rid, &slot, &cid, &ncan) == 0);
+  assert(ncan == 1 && cid == 3);
+
+  // Cancel a running request: reaped via next_cancelled_slot + release.
+  assert(ts_cancel(rt, 2) == 2);
+  assert(ts_next_cancelled_slot(rt) == 1);
+  assert(ts_release(rt, 1) == 2);
+  assert(ts_next_cancelled_slot(rt) == -1);
+  assert(ts_release(rt, 1) == -1);  // double release is a no-op
+
+  // Freed slot is reusable.
+  assert(ts_submit(rt, 4, 5, 8) == 0);
+  assert(ts_pop_admission(rt, &rid, &slot, &cid, &ncan) == 1);
+  assert(rid == 4 && slot == 1);
+
+  ts_get_stats(rt, &st);
+  assert(st.admitted_total == 3 && st.cancelled_total == 2);
+  assert(ts_cancel(rt, 999) == 0);
+
+  ts_destroy(rt);
+  printf("runtime_test: all assertions passed\n");
+  return 0;
+}

@@ -239,3 +239,44 @@ def test_max_tokens_clamped_to_cache_budget(setup):
     assert req.max_tokens == serving.max_cache_len - 10 - 1
     run_engine(engine, [])
     assert req.finish_reason == "length"
+
+
+def test_prefill_failure_releases_scheduler_slot(setup):
+    """A prefill exception must release the scheduler-assigned slot and notify
+    the client (review finding: capacity leaked and waiters hung)."""
+    cfg, params, serving = setup
+    engine = Engine(cfg, params, serving)
+    orig = engine._do_prefill
+    boom = {"armed": True}
+
+    def bad_prefill(req, slot):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("prefill boom")
+        return orig(req, slot)
+
+    engine._do_prefill = bad_prefill
+    r1 = Request(prompt_ids=[1, 2], max_tokens=2, ignore_eos=True)
+    engine.submit(r1)
+    try:
+        engine.step()
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError:
+        pass
+    assert r1.finish_reason == "error"
+    assert r1.out_queue.get(timeout=5) is None
+    assert engine.sched.stats().active_slots == 0  # slot released
+    # capacity intact: a new request completes normally
+    r2 = Request(prompt_ids=[1, 2], max_tokens=2, ignore_eos=True)
+    engine.submit(r2)
+    while engine.pending or any(s is not None for s in engine.slot_req):
+        engine.step()
+    assert len(r2.generated) == 2
+
+
+def test_awkward_cache_len_rounded_for_kernel(setup):
+    cfg, params, serving = setup
+    import dataclasses
+    odd = dataclasses.replace(serving, max_cache_len=509)
+    engine = Engine(cfg, params, odd)
+    assert engine.max_len == 512
