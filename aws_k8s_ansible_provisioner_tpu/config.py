@@ -34,12 +34,14 @@ class ModelConfig:
     ``templates/opt-chat-template.yaml``) — Phi-2 being the canonical "phi"
     template user. Field semantics:
 
-    - ``norm``: "rmsnorm" (Qwen) or "layernorm" (Phi, with bias).
+    - ``norm``: "rmsnorm" (Qwen) or "layernorm" (Phi/OPT, with bias).
     - ``qk_norm``: per-head RMSNorm on q/k projections (Qwen3 innovation).
     - ``parallel_block``: Phi-style parallel attention+MLP residual block.
     - ``rotary_pct``: fraction of head_dim that is rotated (Phi-2 uses 0.4);
       1.0 means full-dim RoPE (Qwen).
-    - ``act``: "silu" → SwiGLU gated MLP; "gelu_new" → plain 2-matrix MLP.
+    - ``act``: "silu" → SwiGLU gated MLP; "gelu_new"/"relu" → plain 2-matrix MLP.
+    - ``pos_embed``: "rope" or "learned" (OPT: learned absolute positions with
+      the family's +2 offset).
     """
 
     name: str
@@ -57,6 +59,7 @@ class ModelConfig:
     norm_eps: float = 1e-6
     qk_norm: bool = False
     act: str = "silu"
+    pos_embed: str = "rope"
     attention_bias: bool = False
     mlp_bias: bool = False
     parallel_block: bool = False
@@ -140,10 +143,56 @@ PHI_2 = ModelConfig(
     hf_repo="microsoft/phi-2",
 )
 
+OPT_125M = ModelConfig(
+    name="facebook/opt-125m",
+    vocab_size=50272,
+    hidden_size=768,
+    intermediate_size=3072,
+    num_layers=12,
+    num_heads=12,
+    num_kv_heads=12,
+    head_dim=64,
+    max_seq_len=2048,
+    norm="layernorm",
+    norm_eps=1e-5,
+    act="relu",
+    pos_embed="learned",
+    attention_bias=True,
+    mlp_bias=True,
+    tie_embeddings=True,
+    bos_token_id=2,
+    eos_token_id=2,
+    hf_repo="facebook/opt-125m",
+)
+
+OPT_1_3B = ModelConfig(
+    name="facebook/opt-1.3b",
+    vocab_size=50272,
+    hidden_size=2048,
+    intermediate_size=8192,
+    num_layers=24,
+    num_heads=32,
+    num_kv_heads=32,
+    head_dim=64,
+    max_seq_len=2048,
+    norm="layernorm",
+    norm_eps=1e-5,
+    act="relu",
+    pos_embed="learned",
+    attention_bias=True,
+    mlp_bias=True,
+    tie_embeddings=True,
+    bos_token_id=2,
+    eos_token_id=2,
+    hf_repo="facebook/opt-1.3b",
+)
+
 MODEL_REGISTRY = {
     "Qwen/Qwen3-0.6B": QWEN3_0_6B,
     "Qwen/Qwen3-8B": QWEN3_8B,
     "microsoft/phi-2": PHI_2,
+    "facebook/opt-125m": OPT_125M,
+    "facebook/opt-1.3b": OPT_1_3B,
 }
 
 
@@ -169,6 +218,31 @@ def tiny_qwen3(**overrides) -> ModelConfig:
         max_seq_len=128,
         rope_theta=1e6,
         qk_norm=True,
+        tie_embeddings=True,
+        eos_token_id=1,
+    )
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+def tiny_opt(**overrides) -> ModelConfig:
+    """A miniature OPT-shaped config (learned positions, ReLU MLP, pre-norm)."""
+    base = dict(
+        name="tiny-opt",
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=4,
+        head_dim=16,
+        max_seq_len=128,
+        norm="layernorm",
+        norm_eps=1e-5,
+        act="relu",
+        pos_embed="learned",
+        attention_bias=True,
+        mlp_bias=True,
         tie_embeddings=True,
         eos_token_id=1,
     )

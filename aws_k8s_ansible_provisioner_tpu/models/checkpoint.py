@@ -1,0 +1,121 @@
+"""Converted-checkpoint cache: orbax save/restore of the JAX param pytree.
+
+The reference's only persistence is weights-as-cache: HF files downloaded once
+into a PVC and reused across pod restarts (SURVEY.md §5 "Checkpoint/resume":
+"persistence-as-cache, not training checkpoints"). This module extends that
+idea one step further down the pipeline: the *converted* JAX pytree (layer
+stacking + transposes + dtype cast already done) is saved next to the HF
+checkpoint after the first load, so subsequent engine starts skip the
+safetensors → pytree conversion entirely — on a pod restart the model goes
+PVC → HBM via one orbax restore. Orbax is also the standard JAX training
+checkpoint format, so the same path restores checkpoints produced by
+``training/trainer.py``.
+
+Layout: ``<checkpoint_dir>/jax_cache/<dtype>/`` (one cache per dtype).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+
+log = logging.getLogger("tpu_serve.checkpoint")
+
+
+def _cache_dir(checkpoint_dir: str, dtype) -> str:
+    return os.path.join(os.path.abspath(checkpoint_dir), "jax_cache",
+                        jnp.dtype(dtype).name)
+
+
+def _fingerprint(checkpoint_dir: str, cfg) -> str:
+    """Hash of the source safetensors (name/size/mtime) + the model config.
+
+    Guards against serving a stale cache after the download Job refreshes the
+    weights in place, or after the config (shapes) changes.
+    """
+    entries = []
+    for f in sorted(os.listdir(checkpoint_dir)):
+        if f.endswith(".safetensors"):
+            st = os.stat(os.path.join(checkpoint_dir, f))
+            entries.append((f, st.st_size, int(st.st_mtime)))
+    try:
+        cfg_dict = dataclasses.asdict(cfg)
+    except TypeError:
+        cfg_dict = repr(cfg)
+    blob = json.dumps([entries, cfg_dict], sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _manifest_path(cache: str) -> str:
+    return os.path.join(cache, "source_manifest.json")
+
+
+def save_params(params, path: str) -> None:
+    """Save a param pytree with orbax (overwrites an existing checkpoint)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if os.path.exists(path):
+            import shutil
+
+            shutil.rmtree(path)
+        ckptr.save(path, params)
+        ckptr.wait_until_finished()
+
+
+def restore_params(path: str, like=None):
+    """Restore a param pytree saved by :func:`save_params`.
+
+    ``like`` (a pytree of arrays or ShapeDtypeStruct) restores with the given
+    shapes/dtypes/shardings; without it the stored metadata is used.
+    """
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is not None:
+            return ckptr.restore(os.path.abspath(path), like)
+        return ckptr.restore(os.path.abspath(path))
+
+
+def load_checkpoint_cached(checkpoint_dir: str, cfg, dtype=jnp.bfloat16,
+                           write_cache: bool = True):
+    """Load params from the orbax cache if present, else convert HF and cache.
+
+    Falls back transparently to the plain HF conversion on any cache error
+    (a corrupt/partial cache from a killed pod must never block serving).
+    """
+    from aws_k8s_ansible_provisioner_tpu.models.hf_loader import load_checkpoint
+
+    cache = _cache_dir(checkpoint_dir, dtype)
+    fp = _fingerprint(checkpoint_dir, cfg)
+    if os.path.isdir(cache):
+        try:
+            with open(_manifest_path(cache)) as fh:
+                stored = json.load(fh).get("fingerprint")
+            if stored != fp:
+                raise ValueError("source checkpoint or config changed "
+                                 "since the cache was written")
+            params = restore_params(cache)
+            params = jax.tree.map(lambda x: jnp.asarray(x, dtype), params)
+            log.info("restored converted params from cache %s", cache)
+            return params
+        except Exception as e:
+            log.warning("checkpoint cache %s not usable (%s); reconverting",
+                        cache, e)
+    params = load_checkpoint(checkpoint_dir, cfg, dtype)
+    if write_cache:
+        try:
+            save_params(params, cache)
+            with open(_manifest_path(cache), "w") as fh:
+                json.dump({"fingerprint": fp}, fh)
+            log.info("wrote converted-params cache %s", cache)
+        except Exception as e:  # read-only volume, quota, ...
+            log.warning("could not write checkpoint cache %s: %s", cache, e)
+    return params

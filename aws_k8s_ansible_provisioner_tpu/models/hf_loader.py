@@ -7,11 +7,14 @@ vLLM do the loading. In the TPU build, loading is in-repo: safetensors →
 axes), optionally placed shard-by-shard onto a ``jax.sharding.Mesh`` so an 8B
 checkpoint never materializes unsharded on one host (SURVEY.md §7 hard part #3).
 
-Key-name maps cover both supported families:
+Key-name maps cover the supported families:
 - Qwen3*: ``model.layers.N.self_attn.{q,k,v,o}_proj``, ``q_norm``/``k_norm``,
   gated ``mlp.{gate,up,down}_proj``, RMSNorm weights.
 - Phi-2: ``self_attn.dense``, ``mlp.fc1/fc2`` with biases, LayerNorm
   weight+bias, ``lm_head`` with bias, no post-attention norm (parallel block).
+- OPT (pre-norm variants): ``model.decoder.layers.N.self_attn.*_proj``,
+  ``self_attn_layer_norm``/``final_layer_norm``, ``fc1/fc2``, learned
+  ``embed_positions`` (+2 offset), tied embeddings.
 """
 
 from __future__ import annotations
@@ -63,14 +66,38 @@ def convert_state_dict(cfg: ModelConfig, tensors: Dict[str, np.ndarray],
             mats.append(w.T if transpose else w)
         return np.stack(mats)
 
-    if phi:
-        pre = "model.layers.{i}.self_attn."
+    opt = cfg.pos_embed == "learned"
+    if opt:
+        # Hub facebook/opt-* safetensors carry bare "decoder.*" keys (exported
+        # from the base OPTModel), while OPTForCausalLM.state_dict() carries
+        # "model.decoder.*". Normalize to the latter so both load.
+        if ("model.decoder.embed_tokens.weight" not in tensors
+                and "decoder.embed_tokens.weight" in tensors):
+            tensors = {("model." + k if k.startswith("decoder.") else k): v
+                       for k, v in tensors.items()}
+        layer_pre = "model.decoder.layers.{i}."
+        pre = layer_pre + "self_attn."
+        o_name, up_name, down_name = "out_proj", "fc1", "fc2"
+        input_norm = layer_pre + "self_attn_layer_norm"
+        post_norm = layer_pre + "final_layer_norm"
+        final_norm = "model.decoder.final_layer_norm"
+        embed_key = "model.decoder.embed_tokens.weight"
+    elif phi:
+        layer_pre = "model.layers.{i}."
+        pre = layer_pre + "self_attn."
         o_name, up_name, down_name = "dense", "mlp.fc1", "mlp.fc2"
+        input_norm = layer_pre + "input_layernorm"
+        post_norm = layer_pre + "post_attention_layernorm"
         final_norm = "model.final_layernorm"
+        embed_key = "model.embed_tokens.weight"
     else:
-        pre = "model.layers.{i}.self_attn."
+        layer_pre = "model.layers.{i}."
+        pre = layer_pre + "self_attn."
         o_name, up_name, down_name = "o_proj", "mlp.up_proj", "mlp.down_proj"
+        input_norm = layer_pre + "input_layernorm"
+        post_norm = layer_pre + "post_attention_layernorm"
         final_norm = "model.norm"
+        embed_key = "model.embed_tokens.weight"
 
     def dense(hf_fmt: str, bias: bool) -> dict:
         p = {"kernel": stack(hf_fmt + ".weight", transpose=True)}
@@ -85,29 +112,32 @@ def convert_state_dict(cfg: ModelConfig, tensors: Dict[str, np.ndarray],
         return p
 
     layers: dict = {
-        "input_norm": norm("model.layers.{i}.input_layernorm"),
+        "input_norm": norm(input_norm),
         "wq": dense(pre + "q_proj", cfg.attention_bias),
         "wk": dense(pre + "k_proj", cfg.attention_bias),
         "wv": dense(pre + "v_proj", cfg.attention_bias),
-        "wo": dense("model.layers.{i}.self_attn." + o_name, cfg.attention_bias),
-        "w_down": dense("model.layers.{i}." + down_name, cfg.mlp_bias),
+        "wo": dense(pre + o_name, cfg.attention_bias),
+        "w_down": dense(layer_pre + down_name, cfg.mlp_bias),
     }
     if cfg.act == "silu":
-        layers["w_gate"] = dense("model.layers.{i}.mlp.gate_proj", cfg.mlp_bias)
-        layers["w_up"] = dense("model.layers.{i}.mlp.up_proj", cfg.mlp_bias)
+        layers["w_gate"] = dense(layer_pre + "mlp.gate_proj", cfg.mlp_bias)
+        layers["w_up"] = dense(layer_pre + "mlp.up_proj", cfg.mlp_bias)
     else:
-        layers["w_up"] = dense("model.layers.{i}." + up_name, cfg.mlp_bias)
+        layers["w_up"] = dense(layer_pre + up_name, cfg.mlp_bias)
     if cfg.qk_norm:
         layers["q_norm"] = {"weight": stack(pre + "q_norm.weight", False)}
         layers["k_norm"] = {"weight": stack(pre + "k_norm.weight", False)}
     if not cfg.parallel_block:
-        layers["post_norm"] = norm("model.layers.{i}.post_attention_layernorm")
+        layers["post_norm"] = norm(post_norm)
 
     params: dict = {
-        "embed": {"weight": _get(tensors, "model.embed_tokens.weight")},
+        "embed": {"weight": _get(tensors, embed_key)},
         "layers": layers,
         "final_norm": {"weight": _get(tensors, final_norm + ".weight")},
     }
+    if opt:
+        params["pos_embed"] = {
+            "weight": _get(tensors, "model.decoder.embed_positions.weight")}
     if cfg.norm == "layernorm":
         params["final_norm"]["bias"] = _get(tensors, final_norm + ".bias")
     if not cfg.tie_embeddings:
@@ -200,6 +230,34 @@ def config_from_hf_dir(checkpoint_dir: str) -> ModelConfig:
             mlp_bias=True,
             parallel_block=True,
             eos_token_id=(hf.get("eos_token_id") or 0),
+            hf_repo=name,
+        )
+    if model_type == "opt":
+        if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"]:
+            raise ValueError("OPT variants with embed projection (350m) are "
+                             "not supported")
+        if not hf.get("do_layer_norm_before", True):
+            raise ValueError("post-norm OPT variants are not supported")
+        head_dim = hf["hidden_size"] // hf["num_attention_heads"]
+        return ModelConfig(
+            name=name,
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["ffn_dim"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf["num_attention_heads"],
+            head_dim=head_dim,
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            norm_eps=1e-5,
+            act="relu",
+            pos_embed="learned",
+            attention_bias=True,
+            mlp_bias=True,
+            tie_embeddings=hf.get("tie_word_embeddings", True),
+            bos_token_id=hf.get("bos_token_id", 2),
+            eos_token_id=(hf.get("eos_token_id") or 2),
             hf_repo=name,
         )
     raise ValueError(f"unsupported model_type {model_type!r} in {checkpoint_dir}")

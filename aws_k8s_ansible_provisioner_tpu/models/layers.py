@@ -208,6 +208,10 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
         "layers": init_layer_params(cfg, k_layers, dtype),
         "final_norm": {"weight": jnp.ones((cfg.hidden_size,), dtype)},
     }
+    if cfg.pos_embed == "learned":
+        # OPT convention: table indexed at position+2 (rows 0-1 are padding).
+        params["pos_embed"] = {"weight": _dense_init(
+            k_head, (cfg.max_seq_len + 2, cfg.hidden_size), dtype)}
     if cfg.norm == "layernorm":
         params["final_norm"]["bias"] = jnp.zeros((cfg.hidden_size,), dtype)
     if not cfg.tie_embeddings:
@@ -239,7 +243,10 @@ def _mlp(cfg: ModelConfig, h: jnp.ndarray, p: dict) -> jnp.ndarray:
     if cfg.act == "silu":
         return _linear(jax.nn.silu(_linear(h, p["w_gate"])) * _linear(h, p["w_up"]),
                        p["w_down"])
-    act = partial(jax.nn.gelu, approximate=True)  # HF "gelu_new"
+    if cfg.act == "relu":  # OPT
+        act = jax.nn.relu
+    else:
+        act = partial(jax.nn.gelu, approximate=True)  # HF "gelu_new"
     return _linear(act(_linear(h, p["w_up"])), p["w_down"])
 
 
@@ -257,8 +264,9 @@ def decoder_block(cfg: ModelConfig, p: dict, x: jnp.ndarray,
     if cfg.qk_norm:  # per-head RMSNorm on q/k (Qwen3)
         q = rms_norm(q, p["q_norm"]["weight"], cfg.norm_eps)
         k = rms_norm(k, p["k_norm"]["weight"], cfg.norm_eps)
-    q = apply_rope(q, cos, sin, rotary_dim)
-    k = apply_rope(k, cos, sin, rotary_dim)
+    if cfg.pos_embed == "rope":
+        q = apply_rope(q, cos, sin, rotary_dim)
+        k = apply_rope(k, cos, sin, rotary_dim)
 
     ctx, new_cache_l = attend(q, k, v, cache_l)
     attn_out = _linear(ctx.reshape(B, T, cfg.q_size), p["wo"])
@@ -284,8 +292,14 @@ def model_forward(
     """Run the decoder; returns (logits [B, T, V], updated cache)."""
     attend = attend or _default_attend
     x = params["embed"]["weight"][tokens]
-    rotary_dim = int(cfg.head_dim * cfg.rotary_pct)
-    cos, sin = rope_cos_sin(positions, rotary_dim, cfg.rope_theta)
+    if cfg.pos_embed == "learned":
+        # OPT: absolute learned positions, +2 offset; no rotary tables needed
+        # (dummy cos/sin keep the scan signature uniform).
+        x = x + params["pos_embed"]["weight"][positions + 2]
+        cos = sin = jnp.zeros(positions.shape + (0,), jnp.float32)
+    else:
+        rotary_dim = int(cfg.head_dim * cfg.rotary_pct)
+        cos, sin = rope_cos_sin(positions, rotary_dim, cfg.rope_theta)
 
     def body(x, layer_in):
         p_l, cache_l = layer_in

@@ -88,6 +88,9 @@ def param_pspecs(cfg: ModelConfig) -> dict:
         "layers": _layer_pspecs(cfg),
         "final_norm": {"weight": P(None)},
     }
+    if cfg.pos_embed == "learned":
+        # OPT position table: tiny, replicate.
+        specs["pos_embed"] = {"weight": P(None, None)}
     if cfg.norm == "layernorm":
         specs["final_norm"]["bias"] = P(None)
     if not cfg.tie_embeddings:

@@ -159,6 +159,10 @@ class Engine:
         # right trade.
         self.max_len = -(-serving.max_cache_len // 256) * 256 \
             if serving.max_cache_len > 256 else serving.max_cache_len
+        # Never exceed the model's position range: RoPE models degrade
+        # gracefully, but a learned position table (OPT) silently clamps its
+        # gather past max_seq_len — same embedding for every later token.
+        self.max_len = min(self.max_len, cfg.max_seq_len)
         self.buckets = tuple(b for b in serving.prefill_buckets
                              if b <= self.max_len)
         dtype = jnp.bfloat16 if serving.dtype == "bfloat16" else jnp.float32

@@ -19,7 +19,9 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import queue
+import tempfile
 import threading
 import time
 import uuid
@@ -42,6 +44,8 @@ class ServerState:
         self.templater = templater
         self.model_name = model_name
         self.started = _now()
+        # Serializes /debug/profile captures (one JAX trace at a time).
+        self.profile_lock = threading.Lock()
 
 
 def _apply_stop_strings(text: str, stops: List[str]) -> Optional[str]:
@@ -117,8 +121,47 @@ class Handler(BaseHTTPRequestHandler):
                 "queue_depth": len(eng.pending),
                 "last_error": eng.last_error or None,
             })
+        elif path == "/debug/profile":
+            self._profile()
         else:
             self._error(404, f"no route for GET {path}")
+
+    def _profile(self):
+        """Capture a JAX/XLA device trace while the engine serves.
+
+        The reference's trace pipeline accepts and drops traces (its only
+        exporter is `debug`, otel-observability-setup.yaml:633-636 — SURVEY.md
+        §5 tracing gap); here profiling is real: a perfetto/TensorBoard-
+        compatible trace is written server-side and its path returned.
+        `?ms=N` controls the capture window (default 1000, max 30000).
+        """
+        import urllib.parse
+
+        import jax as _jax
+
+        q = self.path.split("?", 1)
+        ms = 1000
+        if len(q) == 2:
+            vals = urllib.parse.parse_qs(q[1]).get("ms")
+            if vals and vals[0].isdigit():
+                ms = min(int(vals[0]), 30000)
+        out_dir = os.path.join(
+            tempfile.gettempdir(), "tpu-serve-profile",
+            f"{time.strftime('%Y%m%d-%H%M%S')}-{uuid.uuid4().hex[:8]}")
+        with self.state.profile_lock:
+            try:
+                _jax.profiler.start_trace(out_dir)
+                time.sleep(ms / 1000.0)
+            finally:
+                try:
+                    _jax.profiler.stop_trace()
+                except Exception as e:
+                    self._error(500, f"profiler stop failed: {e}",
+                                "internal_error")
+                    return
+        self._json(200, {"trace_dir": out_dir, "window_ms": ms,
+                         "view": "tensorboard --logdir <trace_dir> "
+                                 "(Profile tab) or perfetto"})
 
     # -- POST ---------------------------------------------------------------
 
@@ -315,7 +358,7 @@ def build_state(serving_cfg=None, model_cfg=None, params=None,
     from aws_k8s_ansible_provisioner_tpu.config import (
         MODEL_REGISTRY, ServingConfig, tiny_qwen3)
     from aws_k8s_ansible_provisioner_tpu.models import (
-        config_from_hf_dir, init_params, load_checkpoint)
+        config_from_hf_dir, init_params)
     from aws_k8s_ansible_provisioner_tpu.serving.chat_template import ChatTemplater
     from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine
     from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import load_tokenizer
@@ -343,7 +386,12 @@ def build_state(serving_cfg=None, model_cfg=None, params=None,
     dtype = jnp.bfloat16 if serving.dtype == "bfloat16" else jnp.float32
     if params is None:
         if ckpt:
-            params = load_checkpoint(ckpt, model_cfg, dtype)
+            # Cached conversion: first start converts safetensors and writes an
+            # orbax cache next to the checkpoint; restarts restore directly.
+            from aws_k8s_ansible_provisioner_tpu.models.checkpoint import (
+                load_checkpoint_cached)
+
+            params = load_checkpoint_cached(ckpt, model_cfg, dtype)
         else:
             log.warning("no checkpoint_dir: serving RANDOM weights (%s) — "
                         "dry-run/benchmark mode only", model_cfg.name)

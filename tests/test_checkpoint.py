@@ -1,0 +1,122 @@
+"""Converted-checkpoint cache tests (persistence-as-cache, SURVEY.md §5)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.models.checkpoint import (
+    load_checkpoint_cached, restore_params, save_params,
+)
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+
+
+def _tree_equal(a, b):
+    fa, fb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x, np.float32),
+                                      np.asarray(y, np.float32))
+
+
+def test_save_restore_roundtrip(tmp_path):
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    path = tmp_path / "ckpt"
+    save_params(params, str(path))
+    restored = restore_params(str(path))
+    _tree_equal(params, restored)
+
+
+def test_save_overwrites_existing(tmp_path):
+    cfg = tiny_qwen3()
+    p1 = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    p2 = init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    path = tmp_path / "ckpt"
+    save_params(p1, str(path))
+    save_params(p2, str(path))
+    _tree_equal(p2, restore_params(str(path)))
+
+
+def test_cached_load_converts_once_then_restores(tmp_path, monkeypatch):
+    """First load converts (and writes the cache); second load must restore
+    without calling the HF conversion at all."""
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    calls = {"n": 0}
+
+    def fake_load(checkpoint_dir, cfg_, dtype):
+        calls["n"] += 1
+        return params
+
+    monkeypatch.setattr(
+        "aws_k8s_ansible_provisioner_tpu.models.hf_loader.load_checkpoint",
+        fake_load)
+
+    got1 = load_checkpoint_cached(str(tmp_path), cfg, dtype=jnp.float32)
+    assert calls["n"] == 1
+    _tree_equal(params, got1)
+
+    got2 = load_checkpoint_cached(str(tmp_path), cfg, dtype=jnp.float32)
+    assert calls["n"] == 1, "second load should hit the orbax cache"
+    _tree_equal(params, got2)
+
+
+def test_corrupt_cache_falls_back_to_conversion(tmp_path, monkeypatch):
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    monkeypatch.setattr(
+        "aws_k8s_ansible_provisioner_tpu.models.hf_loader.load_checkpoint",
+        lambda d, c, t: params)
+    # Plant a garbage cache dir where orbax expects a checkpoint.
+    cache = tmp_path / "jax_cache" / "float32"
+    cache.mkdir(parents=True)
+    (cache / "not_a_checkpoint").write_text("garbage")
+
+    got = load_checkpoint_cached(str(tmp_path), cfg, dtype=jnp.float32)
+    _tree_equal(params, got)
+
+
+def test_dtype_separate_caches(tmp_path, monkeypatch):
+    cfg = tiny_qwen3()
+
+    def fake_load(checkpoint_dir, cfg_, dtype):
+        return init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+
+    monkeypatch.setattr(
+        "aws_k8s_ansible_provisioner_tpu.models.hf_loader.load_checkpoint",
+        fake_load)
+    a = load_checkpoint_cached(str(tmp_path), cfg, dtype=jnp.float32)
+    b = load_checkpoint_cached(str(tmp_path), cfg, dtype=jnp.bfloat16)
+    assert jax.tree.leaves(a)[0].dtype == jnp.float32
+    assert jax.tree.leaves(b)[0].dtype == jnp.bfloat16
+    assert (tmp_path / "jax_cache" / "float32").is_dir()
+    assert (tmp_path / "jax_cache" / "bfloat16").is_dir()
+
+
+def test_stale_cache_invalidated_by_source_change(tmp_path, monkeypatch):
+    """If the safetensors under checkpoint_dir change, the cache must NOT be
+    served (review finding: stale-weights hazard after a re-download)."""
+    import time
+
+    cfg = tiny_qwen3()
+    p_old = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    p_new = init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    current = {"params": p_old}
+    monkeypatch.setattr(
+        "aws_k8s_ansible_provisioner_tpu.models.hf_loader.load_checkpoint",
+        lambda d, c, t: current["params"])
+
+    st = tmp_path / "model.safetensors"
+    st.write_bytes(b"v1")
+    got = load_checkpoint_cached(str(tmp_path), cfg, dtype=jnp.float32)
+    _tree_equal(p_old, got)
+
+    # "re-download": contents + mtime change
+    time.sleep(0.01)
+    st.write_bytes(b"v2-longer")
+    current["params"] = p_new
+    got = load_checkpoint_cached(str(tmp_path), cfg, dtype=jnp.float32)
+    _tree_equal(p_new, got)

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3, tiny_phi
+from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_opt, tiny_phi, tiny_qwen3
 from aws_k8s_ansible_provisioner_tpu.models.layers import init_params, model_forward
 from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
 
@@ -49,9 +49,9 @@ def naive_greedy(params, cfg, prompt, n):
     return out
 
 
-@pytest.fixture(scope="module", params=["qwen3", "phi"])
+@pytest.fixture(scope="module", params=["qwen3", "phi", "opt"])
 def setup(request):
-    cfg = tiny_qwen3() if request.param == "qwen3" else tiny_phi()
+    cfg = {"qwen3": tiny_qwen3, "phi": tiny_phi, "opt": tiny_opt}[request.param]()
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     serving = ServingConfig(max_decode_slots=4, max_cache_len=64,
                             prefill_buckets=(8, 16, 32), dtype="float32")
@@ -277,6 +277,8 @@ def test_prefill_failure_releases_scheduler_slot(setup):
 def test_awkward_cache_len_rounded_for_kernel(setup):
     cfg, params, serving = setup
     import dataclasses
+    # give the model enough position range that only the rounding applies
+    cfg = cfg.scaled(max_seq_len=2048)
     odd = dataclasses.replace(serving, max_cache_len=509)
     engine = Engine(cfg, params, odd)
     assert engine.max_len == 512

@@ -218,3 +218,19 @@ def test_nonstream_stop_string_truncates(server):
     choice = body["choices"][0]
     assert choice["text"] == text[:text.find(stop)]
     assert choice["finish_reason"] == "stop"
+
+
+def test_debug_profile_captures_trace(server):
+    """/debug/profile returns a trace dir after a short capture window
+    (SURVEY.md §5: the reference accepts-and-drops traces; ours are real)."""
+    import os
+
+    status, body = _get(server + "/debug/profile?ms=50")
+    assert status == 200
+    assert body["window_ms"] == 50
+    assert os.path.isdir(body["trace_dir"])
+    # jax writes a plugins/profile tree with at least one artifact
+    found = []
+    for root, _, files in os.walk(body["trace_dir"]):
+        found.extend(files)
+    assert found, "profiler produced no trace artifacts"
