@@ -63,20 +63,46 @@ def resolve_impl(impl: str = "auto") -> str:
     return impl
 
 
-def make_decode_attend(lengths: jnp.ndarray, impl: str = "auto"):
+def make_decode_attend(lengths: jnp.ndarray, impl: str = "auto", mesh=None):
     """Attend callback for model_forward: writes the new token, then attends.
 
     ``lengths`` are the pre-step lengths (position of the incoming token).
+
+    With a ``mesh``, the Pallas kernel runs under ``shard_map``: decode
+    attention is (slot, head)-local, so slots shard over ``dp`` and heads over
+    ``tp`` with ZERO collectives — each device runs the kernel on its own
+    cache shard (XLA can't partition a custom call on its own, so without
+    shard_map the kernel would force an all-gather of the cache). The XLA
+    fallback needs no wrapper: GSPMD partitions its einsums directly.
     """
     resolved = resolve_impl(impl)
+
+    def _pallas(q, k, v, lens):
+        from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
+
+        interpret = jax.default_backend() != "tpu"
+        return pallas_attention.decode_attend_pallas(q, k, v, lens,
+                                                     interpret=interpret)
 
     def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
         cache_l = kvc.write_token(cache_l, lengths, k, v)
         if resolved == "pallas":
-            from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
+            if mesh is not None:
+                from jax.experimental.shard_map import shard_map
+                from jax.sharding import PartitionSpec as P
 
-            ctx = pallas_attention.decode_attend_pallas(
-                q, cache_l["k"], cache_l["v"], lengths + 1)
+                fn = shard_map(
+                    _pallas, mesh=mesh,
+                    in_specs=(P("dp", None, "tp", None),   # q [B,1,Hq,D]
+                              P("dp", "tp", None, None),   # k [B,Hkv,S,D]
+                              P("dp", "tp", None, None),   # v
+                              P("dp")),                    # lengths [B]
+                    out_specs=P("dp", None, "tp", None),
+                    check_rep=False,
+                )
+                ctx = fn(q, cache_l["k"], cache_l["v"], lengths + 1)
+            else:
+                ctx = _pallas(q, cache_l["k"], cache_l["v"], lengths + 1)
         else:
             ctx = decode_attend(q, cache_l["k"], cache_l["v"], lengths + 1)
         return ctx, cache_l

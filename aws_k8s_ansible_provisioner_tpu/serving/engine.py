@@ -105,9 +105,10 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
     return cache, token
 
 
-@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",),
+         donate_argnums=(3,))
 def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
-                 lengths, rng, temperature, top_k, top_p):
+                 lengths, rng, temperature, top_k, top_p, mesh=None):
     """``n_steps`` fused decode steps for every slot, one device dispatch.
 
     tokens/lengths/sampling params: [B]. Returns (cache, out [n_steps, B]).
@@ -125,7 +126,7 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
     def body(carry, rng_i):
         cache, tok, lens = carry
         positions = lens[:, None]
-        attend = make_decode_attend(lens)
+        attend = make_decode_attend(lens, mesh=mesh)
         logits, cache = model_forward(params, cfg, tok[:, None], positions,
                                       cache, attend)
         nxt = sample(logits[:, 0, :], rng_i, temperature, top_k, top_p)
@@ -145,7 +146,7 @@ class Engine:
     """Continuous-batching engine over a fixed set of decode slots."""
 
     def __init__(self, cfg: ModelConfig, params, serving: ServingConfig,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None, mesh=None):
         self.cfg = cfg
         self.params = params
         self.serving = serving
@@ -166,7 +167,34 @@ class Engine:
         self.buckets = tuple(b for b in serving.prefill_buckets
                              if b <= self.max_len)
         dtype = jnp.bfloat16 if serving.dtype == "bfloat16" else jnp.float32
+
+        # Multi-chip serving: a (dp, tp) mesh shards params (Megatron TP),
+        # slots over dp, and kv heads over tp (parallel/sharding.py). The
+        # comms backend is XLA collectives over ICI — GSPMD partitions the
+        # matmuls, shard_map runs the Pallas kernel per-shard (SURVEY.md §2.3:
+        # every parallelism capability is net-new on the TPU side).
+        self.mesh = mesh if mesh is not None else self._build_mesh(serving)
+        if self.mesh is not None:
+            from aws_k8s_ansible_provisioner_tpu.parallel.sharding import (
+                cache_pspecs, check_tp_divisibility, shard_params)
+
+            tp = self.mesh.shape["tp"]
+            dp = self.mesh.shape["dp"]
+            check_tp_divisibility(cfg, tp)
+            if self.num_slots % dp:
+                raise ValueError(f"max_decode_slots={self.num_slots} must be "
+                                 f"divisible by dp={dp}")
+            self.params = params = shard_params(params, self.mesh, cfg)
         self.cache = kvc.init_cache(cfg, self.num_slots, self.max_len, dtype)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from aws_k8s_ansible_provisioner_tpu.parallel.sharding import (
+                cache_pspecs)
+
+            self.cache = jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+                self.cache, cache_pspecs())
 
         self.metrics = EngineMetrics()
         self._rng = jax.random.PRNGKey(0)
@@ -188,6 +216,20 @@ class Engine:
         self._lock = threading.Lock()
         self._work_event = threading.Event()
         self._tok_times: Deque = collections.deque(maxlen=50)
+
+    @staticmethod
+    def _build_mesh(serving: ServingConfig):
+        """Build the serving mesh from config (None for single-device)."""
+        mc = serving.mesh
+        if mc.num_devices <= 1:
+            return None
+        if mc.sp != 1:
+            raise ValueError("serving mesh uses dp/tp only (sp is a training/"
+                             "long-context axis); got sp="
+                             f"{mc.sp}")
+        from aws_k8s_ansible_provisioner_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(mc)
 
     @property
     def pending(self):
@@ -330,7 +372,8 @@ class Engine:
             self.cfg, horizon, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
             self._next_rng(), jnp.asarray(self.temps),
-            jnp.asarray(self.top_ks), jnp.asarray(self.top_ps))
+            jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
+            mesh=self.mesh)
         out = np.asarray(out)  # [horizon, B]
         dt = time.monotonic() - t0
         self.metrics.decode_step_duration.observe(dt / horizon)
@@ -464,4 +507,5 @@ class Engine:
             self.cfg, 1, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
             self._next_rng(), jnp.asarray(self.temps),
-            jnp.asarray(self.top_ks), jnp.asarray(self.top_ps))
+            jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
+            mesh=self.mesh)

@@ -453,6 +453,11 @@ def main(argv=None):
                    help="path to a Jinja chat template file")
     p.add_argument("--platform", default="",
                    help="force a JAX platform (e.g. cpu for dry-run)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree (shards heads/MLP over the "
+                        "ICI mesh; needs tp devices)")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel degree (shards decode slots)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
@@ -466,11 +471,14 @@ def main(argv=None):
 
         jax.config.update("jax_platforms", args.platform)
 
+    from aws_k8s_ansible_provisioner_tpu.config import MeshConfig
+
     serving = ServingConfig(
         model=args.model, port=args.port, host=args.host,
         max_decode_slots=args.max_decode_slots,
         max_cache_len=args.max_cache_len, dtype=args.dtype,
-        checkpoint_dir=args.checkpoint_dir, chat_template=args.chat_template)
+        checkpoint_dir=args.checkpoint_dir, chat_template=args.chat_template,
+        mesh=MeshConfig(dp=args.dp, tp=args.tp))
     state = build_state(serving)
     if not args.no_warmup:
         log.info("warmup: compiling %d prefill buckets + decode ...",
