@@ -1,0 +1,40 @@
+# Framework container image: the JAX/XLA serving engine, gateway router, and
+# k8s runtime components (device plugin, metrics exporter) in one image.
+#
+# The reference pulled its engine as a public vLLM image via the llm-d
+# installer (reference llm-d-deploy.yaml:176-193); this repo serves its OWN
+# code, so shipping the image is part of the L3 capability: the serving-deploy
+# playbook builds this on the node with podman (root podman and CRI-O share
+# /var/lib/containers/storage, so the kubelet sees the image immediately —
+# manifests pin imagePullPolicy: Never so nothing ever tries a registry).
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        build-essential make \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/tpu-serve
+
+# TPU runtime: jax + libtpu from the official release index. The very same
+# image dry-runs on CPU (JAX_PLATFORMS=cpu) — the offline/kind path of
+# BASELINE.json config #1 uses it with zero changes.
+RUN pip install --no-cache-dir "jax[tpu]" \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir \
+        transformers safetensors orbax-checkpoint grpcio numpy
+
+COPY pyproject.toml ./
+COPY aws_k8s_ansible_provisioner_tpu ./aws_k8s_ansible_provisioner_tpu
+COPY native ./native
+COPY templates ./templates
+
+# Native runtime core (C++ scheduler/allocator, ctypes-loaded) + metrics
+# exporter binary, then the Python package itself.
+RUN make -C native clean && make -C native && pip install --no-cache-dir .
+
+# Where runtime/scheduler.py looks for libtpu_serve_runtime.so.
+ENV TPU_SERVE_NATIVE_DIR=/opt/tpu-serve/native/build
+EXPOSE 8000
+# Default command is the engine; the device plugin / exporter / router
+# override `command` in their manifests.
+CMD ["python", "-m", "aws_k8s_ansible_provisioner_tpu.serving.server"]

@@ -93,10 +93,13 @@ class DevicePluginServicer:
             # (/dev/accel3 → chip 3), not renumbered from 0 — otherwise two
             # pods sharing a host would both be pointed at chips 0..n-1.
             chips = ",".join(_chip_index(d) for d in ids)
-            envs = {
-                "TPU_VISIBLE_CHIPS": chips,
-                "TPU_CHIPS_PER_PROCESS_BOUNDS": f"1,{max(len(ids), 1)},1",
-            }
+            # Only TPU_VISIBLE_CHIPS is set; TPU_CHIPS_PER_PROCESS_BOUNDS is
+            # deliberately omitted so libtpu infers bounds from the real chip
+            # topology. Hardcoding "1,N,1" broke partial allocations on hosts
+            # whose physical layout differs (e.g. a 4-chip v5e host is 2,2,1 —
+            # libtpu validates bounds against topology and refuses to
+            # initialize on mismatch; ADVICE r1).
+            envs = {"TPU_VISIBLE_CHIPS": chips}
             responses.append(pw.container_allocate_response(envs, ids))
             log.info("allocate: %s -> TPU_VISIBLE_CHIPS=%s", ids, chips)
         return pw.allocate_response(responses)

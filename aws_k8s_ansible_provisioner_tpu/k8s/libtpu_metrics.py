@@ -1,0 +1,138 @@
+"""libtpu runtime-metrics client: the ``tpu-info`` telemetry path.
+
+When a process (our serving engine, or any JAX program) initializes libtpu,
+the runtime starts a local gRPC service (default ``localhost:8431``) exposing
+runtime metrics — the same service the ``tpu-info`` CLI reads. This module is
+a minimal client for it, replacing the DCGM path of the reference stack
+(reference kubernetes-single-node.yaml:480-504): the metrics exporter runs in
+a DIFFERENT process/pod than the engine that owns the chips, and this service
+is how chip telemetry crosses that process boundary.
+
+Wire format: grpc over HTTP/2 with hand-rolled protobuf (protowire), matching
+the public ``tpu_metric_service.proto`` used by tpu-info:
+
+    service RuntimeMetricService {
+      rpc GetRuntimeMetric(MetricRequest) returns (MetricResponse);
+    }
+    message MetricRequest  { string metric_name = 1; }
+    message MetricResponse { TPUMetric metric = 1; }
+    message TPUMetric { string name = 1; repeated Measurement measurement = 2; }
+    message Measurement { Attribute attribute = 1; Gauge gauge = 2; }
+    message Attribute { string key = 1; AttrValue value = 2; }
+    message AttrValue { oneof attr { int64 int_attr = 1; string str_attr = 2; } }
+    message Gauge { oneof value { int64 as_int = 1; double as_double = 2; } }
+
+Decoding is deliberately TOLERANT: we walk the message tree generically and
+extract (device_id, value) pairs from each measurement, so minor schema
+evolution degrades to missing data, never to garbage. Every call is
+best-effort — on any failure the caller falls back to other telemetry
+sources (see metrics_exporter.TpuTelemetry).
+
+Known metric names (tpu-info's set):
+    tpu.runtime.hbm.memory.usage.bytes
+    tpu.runtime.hbm.memory.total.bytes
+    tpu.runtime.tensorcore.dutycycle.percent
+"""
+
+from __future__ import annotations
+
+import logging
+import struct
+from typing import Dict, Optional
+
+from aws_k8s_ansible_provisioner_tpu.k8s import protowire as pw
+
+log = logging.getLogger("tpu_serve.libtpu_metrics")
+
+DEFAULT_ADDR = "localhost:8431"
+HBM_USAGE = "tpu.runtime.hbm.memory.usage.bytes"
+HBM_TOTAL = "tpu.runtime.hbm.memory.total.bytes"
+DUTY_CYCLE = "tpu.runtime.tensorcore.dutycycle.percent"
+
+_WIRE_VARINT, _WIRE_I64, _WIRE_LEN, _WIRE_I32 = 0, 1, 2, 5
+
+
+def _parse_measurement(buf: bytes) -> Optional[tuple]:
+    """One Measurement -> (device_id, value) via a tolerant walk.
+
+    The device id is the int attribute (field 1 -> Attribute -> value ->
+    int_attr); the reading is the gauge (field 2 -> as_int or as_double).
+    """
+    device_id = None
+    value = None
+    for field, wire, payload in pw.iter_fields(buf):
+        if wire != _WIRE_LEN or not isinstance(payload, bytes):
+            continue
+        if field == 1:  # Attribute
+            for f2, w2, p2 in pw.iter_fields(payload):
+                if f2 == 2 and w2 == _WIRE_LEN and isinstance(p2, bytes):
+                    for f3, w3, p3 in pw.iter_fields(p2):
+                        if f3 == 1 and w3 == _WIRE_VARINT:
+                            device_id = int(p3)
+        elif field == 2:  # Gauge
+            for f2, w2, p2 in pw.iter_fields(payload):
+                if f2 == 1 and w2 == _WIRE_VARINT:
+                    value = float(int(p2))
+                elif f2 == 2 and w2 == _WIRE_I64:
+                    value = struct.unpack("<d", p2)[0]
+    if value is None:
+        return None
+    return (device_id if device_id is not None else 0, value)
+
+
+def _parse_response(buf: bytes) -> Dict[int, float]:
+    """MetricResponse -> {device_id: value}."""
+    out: Dict[int, float] = {}
+    for field, wire, payload in pw.iter_fields(buf):
+        if field != 1 or wire != _WIRE_LEN or not isinstance(payload, bytes):
+            continue  # TPUMetric
+        for f2, w2, p2 in pw.iter_fields(payload):
+            if f2 == 2 and w2 == _WIRE_LEN and isinstance(p2, bytes):
+                m = _parse_measurement(p2)
+                if m is not None:
+                    out[m[0]] = m[1]
+    return out
+
+
+def get_metric(metric_name: str, addr: str = DEFAULT_ADDR,
+               timeout_s: float = 2.0) -> Optional[Dict[int, float]]:
+    """Query one runtime metric; {device_id: value}, or None if unreachable."""
+    try:
+        import grpc
+    except Exception:
+        return None
+    request = pw.encode_string(1, metric_name)
+    try:
+        channel = grpc.insecure_channel(addr)
+        call = channel.unary_unary(
+            "/tpu.monitoring.runtime.RuntimeMetricService/GetRuntimeMetric",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        resp = call(request, timeout=timeout_s)
+        channel.close()
+        return _parse_response(resp)
+    except Exception as e:
+        log.debug("libtpu metric %s unavailable at %s: %s",
+                  metric_name, addr, e)
+        return None
+
+
+def snapshot(addr: str = DEFAULT_ADDR) -> Optional[list]:
+    """Full per-chip snapshot from libtpu, or None if the service is absent."""
+    usage = get_metric(HBM_USAGE, addr)
+    if usage is None:
+        return None
+    total = get_metric(HBM_TOTAL, addr) or {}
+    duty = get_metric(DUTY_CYCLE, addr) or {}
+    chips = []
+    for dev in sorted(set(usage) | set(total) | set(duty)):
+        chips.append({
+            "chip": str(dev),
+            "kind": "tpu",
+            "hbm_used": usage.get(dev, 0.0),
+            "hbm_capacity": total.get(dev, 0.0),
+            "duty_cycle": duty.get(dev, 0.0),
+            "tensorcore_util": duty.get(dev, 0.0),
+        })
+    return chips or None

@@ -68,8 +68,9 @@ def decode_varint(buf: bytes, pos: int) -> Tuple[int, int]:
 def iter_fields(buf: bytes) -> Iterator[Tuple[int, int, bytes | int]]:
     """Yield (field_number, wire_type, value) over a serialized message.
 
-    Length-delimited values come back as bytes; varints as int. Groups and
-    fixed32/64 are not used by the device-plugin API and raise.
+    Length-delimited values come back as bytes; varints as int; fixed64/
+    fixed32 as their raw little-endian bytes (callers struct.unpack — the
+    libtpu metrics Gauge uses a double). Groups raise.
     """
     pos = 0
     while pos < len(buf):
@@ -82,6 +83,12 @@ def iter_fields(buf: bytes) -> Iterator[Tuple[int, int, bytes | int]]:
             ln, pos = decode_varint(buf, pos)
             yield field, wt, buf[pos:pos + ln]
             pos += ln
+        elif wt == 1:  # fixed64 (e.g. double gauge values)
+            yield field, wt, buf[pos:pos + 8]
+            pos += 8
+        elif wt == 5:  # fixed32
+            yield field, wt, buf[pos:pos + 4]
+            pos += 4
         else:
             raise ValueError(f"unsupported wire type {wt} for field {field}")
 

@@ -122,3 +122,25 @@ def shard_params(params: Any, mesh: Mesh, cfg: ModelConfig) -> Any:
     """Place an (unsharded or host) param pytree onto the mesh per the rules."""
     shardings = param_shardings(mesh, cfg)
     return jax.tree.map(jax.device_put, params, shardings)
+
+
+def make_sharded_device_put(mesh: Mesh, cfg: ModelConfig):
+    """Per-leaf placement callback for ``hf_loader.load_checkpoint``.
+
+    Maps each pytree path to its PartitionSpec and device_puts the leaf with
+    that NamedSharding as it is converted: the host→device transfer per device
+    is the SHARD, and no device ever holds a full-model buffer — the property
+    that lets an 8B checkpoint load onto a v5e-8 slice whose chips each hold
+    1/8 of the weights (SURVEY.md §7 hard part #3).
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        param_pspecs(cfg), is_leaf=lambda x: isinstance(x, P))
+    specs = {jax.tree_util.keystr(path): s for path, s in flat}
+
+    def put(path: str, arr):
+        spec = specs.get(path)
+        if spec is None:  # unexpected leaf: replicate (never silently drop)
+            spec = P()
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return put

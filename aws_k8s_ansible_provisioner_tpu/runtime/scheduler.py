@@ -27,11 +27,16 @@ import threading
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
-_LIB_PATHS = (
+_LIB_PATHS = tuple(p for p in (
+    # Container image sets TPU_SERVE_NATIVE_DIR (the package is pip-installed
+    # there, so the repo-relative path below doesn't exist in the image).
+    os.path.join(os.environ.get("TPU_SERVE_NATIVE_DIR", ""),
+                 "libtpu_serve_runtime.so")
+    if os.environ.get("TPU_SERVE_NATIVE_DIR") else "",
     os.path.join(os.path.dirname(__file__), "..", "..", "native", "build",
                  "libtpu_serve_runtime.so"),
     "/usr/local/lib/libtpu_serve_runtime.so",
-)
+) if p)
 
 
 @dataclass

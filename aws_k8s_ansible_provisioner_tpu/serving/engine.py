@@ -4,11 +4,14 @@ The reference delegates this entire component to the external vLLM container
 (SURVEY.md §0 item 4, §2.2 row 1); here it is in-repo and TPU-native:
 
 - **A small fixed set of compiled programs** drives everything:
-  ``prefill_step`` (one program per prompt-length bucket) and ``decode_steps``
-  (two programs over all slots: fused horizon=N when no prompt waits,
-  horizon=1 otherwise — ``n_steps`` is static). Static shapes throughout — XLA's compilation model is the design constraint
-  (SURVEY.md §7 hard part #2: "continuous batching under XLA's static-shape
-  constraint").
+  ``prefill_step`` (one program per prompt-length bucket),
+  ``prefill_batch_step`` (N waiting prompts in one dispatch, N a power of
+  two), ``prefill_chunk_step`` (one fixed-size chunk of a long prompt, decode
+  interleaved between chunks), and ``decode_steps`` (two programs over all
+  slots: fused horizon=N when no prompt waits, horizon=1 otherwise —
+  ``n_steps`` is static). Static shapes throughout — XLA's compilation model
+  is the design constraint (SURVEY.md §7 hard part #2: "continuous batching
+  under XLA's static-shape constraint").
 - **Prefill/decode interleaving** with prefill priority: TTFT p50 is the headline
   baseline metric (BASELINE.json), and a waiting prompt hurts TTFT more than one
   decode step hurts per-token latency.
@@ -39,14 +42,31 @@ import numpy as np
 from aws_k8s_ansible_provisioner_tpu.config import ModelConfig, ServingConfig
 from aws_k8s_ansible_provisioner_tpu.models.layers import model_forward
 from aws_k8s_ansible_provisioner_tpu.ops.attention import (
+    make_chunk_prefill_attend,
     make_decode_attend,
     make_prefill_attend,
+    make_prefill_attend_batch,
 )
 from aws_k8s_ansible_provisioner_tpu.ops.sampling import sample
 from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import EngineMetrics
 
 _REQUEST_IDS = itertools.count()
+
+
+class ContextLengthExceeded(ValueError):
+    """Prompt does not fit the engine's context window.
+
+    Raised by :meth:`Engine.submit` instead of silently truncating the prompt
+    tail — the server maps this to the OpenAI ``400 context_length_exceeded``
+    error the reference's vLLM engine returns for the same condition.
+    """
+
+    def __init__(self, n_prompt: int, limit: int, max_len: int):
+        self.n_prompt, self.limit, self.max_len = n_prompt, limit, max_len
+        super().__init__(
+            f"This model's maximum prompt length is {limit} tokens "
+            f"(context window {max_len}); your prompt has {n_prompt} tokens.")
 
 
 @dataclass
@@ -105,10 +125,55 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
     return cache, token
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",),
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
+                       slots, rng, temperature, top_k, top_p):
+    """Prefill N prompts into N slots in ONE dispatch.
+
+    tokens: [N, T] right-padded to a (row, length) bucket; true_lens/slots/
+    sampling params: [N]. Padding rows carry slot index == num_slots (their
+    cache writes drop) — the host ignores their sampled tokens. Returns
+    (cache, first tokens [N]). One program per (N-bucket, T-bucket) pair;
+    under a burst this turns N serialized prefill dispatches into
+    ceil(N/batch) (VERDICT r1 missing #4).
+    """
+    N, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (N, T))
+    attend = make_prefill_attend_batch(slots, true_lens)
+    logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
+    last = logits[jnp.arange(N), true_lens - 1]            # [N, V]
+    toks = sample(last, rng, temperature, top_k, top_p)
+    return cache, toks
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
+                       chunk_len, rng, temperature, top_k, top_p):
+    """Prefill ONE chunk of a long prompt; decode interleaves between chunks.
+
+    tokens: [1, C] (the chunk, right-padded on the final chunk); start: row
+    offset of this chunk in the slot; chunk_len: valid tokens in this chunk.
+    Returns (cache, sampled token from the chunk's last valid row) — the host
+    uses the token only after the FINAL chunk (it is the request's first
+    generated token); for earlier chunks it is discarded. One compiled
+    program for all chunks (C static), versus one program per prompt-length
+    bucket for whole-prompt prefill.
+    """
+    C = tokens.shape[1]
+    positions = start + jnp.arange(C, dtype=jnp.int32)[None, :]
+    attend = make_chunk_prefill_attend(slot, start)
+    logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
+    last = jnp.take(logits[0], chunk_len - 1, axis=0)      # [V]
+    token = sample(last[None, :], rng, temperature[None], top_k[None],
+                   top_p[None])[0]
+    return cache, token
+
+
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "impl"),
          donate_argnums=(3,))
 def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
-                 lengths, rng, temperature, top_k, top_p, mesh=None):
+                 lengths, rng, temperature, top_k, top_p, mesh=None,
+                 impl: str = "auto"):
     """``n_steps`` fused decode steps for every slot, one device dispatch.
 
     tokens/lengths/sampling params: [B]. Returns (cache, out [n_steps, B]).
@@ -126,7 +191,7 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
     def body(carry, rng_i):
         cache, tok, lens = carry
         positions = lens[:, None]
-        attend = make_decode_attend(lens, mesh=mesh)
+        attend = make_decode_attend(lens, impl=impl, mesh=mesh)
         logits, cache = model_forward(params, cfg, tok[:, None], positions,
                                       cache, attend)
         nxt = sample(logits[:, 0, :], rng_i, temperature, top_k, top_p)
@@ -185,16 +250,26 @@ class Engine:
                 raise ValueError(f"max_decode_slots={self.num_slots} must be "
                                  f"divisible by dp={dp}")
             self.params = params = shard_params(params, self.mesh, cfg)
-        self.cache = kvc.init_cache(cfg, self.num_slots, self.max_len, dtype)
         if self.mesh is not None:
+            # Allocate the cache DIRECTLY sharded (jit with out_shardings):
+            # each device materializes only its own shard. Building unsharded
+            # and re-sharding with device_put would peak one device's HBM at
+            # the FULL cache size — defeating the capacity scaling the dp/tp
+            # mesh exists to provide (ADVICE r1, medium).
             from jax.sharding import NamedSharding
 
             from aws_k8s_ansible_provisioner_tpu.parallel.sharding import (
                 cache_pspecs)
 
-            self.cache = jax.tree.map(
-                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
-                self.cache, cache_pspecs())
+            out_sh = {name: NamedSharding(self.mesh, spec)
+                      for name, spec in cache_pspecs().items()}
+            self.cache = jax.jit(
+                lambda: kvc.init_cache(cfg, self.num_slots, self.max_len,
+                                       dtype),
+                out_shardings=out_sh)()
+        else:
+            self.cache = kvc.init_cache(cfg, self.num_slots, self.max_len,
+                                        dtype)
 
         self.metrics = EngineMetrics()
         self._rng = jax.random.PRNGKey(0)
@@ -216,6 +291,11 @@ class Engine:
         self._lock = threading.Lock()
         self._work_event = threading.Event()
         self._tok_times: Deque = collections.deque(maxlen=50)
+        # Chunked-prefill state: {"req", "slot", "off"} while a long prompt is
+        # being prefilled chunk-by-chunk; decode steps interleave between
+        # chunks (self._chunk_yield alternates).
+        self._chunk: Optional[dict] = None
+        self._chunk_yield = False
 
     @staticmethod
     def _build_mesh(serving: ServingConfig):
@@ -239,14 +319,37 @@ class Engine:
 
     # -- submission ---------------------------------------------------------
 
+    @property
+    def prompt_limit(self) -> int:
+        """Longest prompt a slot can hold.
+
+        Whole-prompt prefill is bound by the largest bucket; chunked prefill
+        (serving.prefill_chunk > 0) lifts that to the cache window itself —
+        any prompt that physically fits the slot is servable in chunks.
+        """
+        if self.serving.prefill_chunk > 0:
+            return self.max_len - 2
+        return min(self.buckets[-1], self.max_len - 2)
+
+    def _should_chunk(self, req: Request) -> bool:
+        if self.serving.prefill_chunk <= 0:
+            return False
+        n = len(req.prompt_ids)
+        # Chunk when the prompt exceeds the chunk size OR the largest bucket:
+        # with chunking enabled, prompt_limit is lifted past the buckets, so a
+        # prompt in (buckets[-1], prefill_chunk] must take the chunked path
+        # too — the whole-prompt path cannot represent it (review r2 #2).
+        return n > self.serving.prefill_chunk or n > self.buckets[-1]
+
     def submit(self, req: Request) -> Request:
         req.t_submit = time.monotonic()
-        # Fit prompt + generation into the slot: first bound the prompt to what a
-        # slot can hold at all, then clamp max_tokens to the remaining budget —
-        # never silently drop the prompt in favor of an oversized max_tokens.
-        prompt_limit = min(self.buckets[-1], self.max_len - 2)
-        if len(req.prompt_ids) > prompt_limit:
-            req.prompt_ids = req.prompt_ids[-prompt_limit:]  # keep the tail
+        # A prompt that doesn't fit is an ERROR, not a truncation: serving the
+        # tail of a too-long prompt silently answers a different question
+        # (the reference's vLLM rejects with 400 context_length_exceeded).
+        # max_tokens, by contrast, is a *budget* and clamps to what's left.
+        if len(req.prompt_ids) > self.prompt_limit:
+            raise ContextLengthExceeded(len(req.prompt_ids), self.prompt_limit,
+                                        self.max_len)
         budget = self.max_len - len(req.prompt_ids) - 1
         if req.max_tokens > budget:
             req.max_tokens = max(1, budget)
@@ -286,16 +389,38 @@ class Engine:
         self._work_event.set()
 
     def step(self) -> bool:
-        """One scheduling step: a prefill if possible, else a decode. Returns
-        whether any work was done."""
+        """One scheduling step. Priority: advance a chunked prefill (with one
+        decode step interleaved between chunks), else admit waiting prompts
+        (batched into one dispatch), else decode. Returns whether any work was
+        done."""
         # reap cancelled slots first so disconnected clients free capacity
         for slot, r in enumerate(self.slot_req):
             if r is not None and r.cancelled:
                 r.finish_reason = "cancelled"
                 self._finish(slot)
+        # A long prompt mid-chunking: alternate chunk and decode dispatches so
+        # in-flight streams keep progressing during the prefill (the whole
+        # point of chunking — VERDICT r1 missing #4).
+        if self._chunk is not None:
+            if self._chunk_yield and self._active_slots():
+                self._chunk_yield = False
+                # horizon must be 1 while chunking: the decode program writes
+                # a k/v row for EVERY slot at its current length — for the
+                # chunking slot that row is garbage at offset `off`, which the
+                # next chunk overwrites only if the write stays within the
+                # next chunk's span.
+                self._do_decode(max_horizon=1)
+                return True
+            self._advance_chunk()
+            self._chunk_yield = True
+            return True
         # Admission decisions come from the runtime core (FCFS; skips
         # cancelled-in-queue requests, surfacing them for client notification).
-        while True:
+        # Bucket-fitting prompts batch into one dispatch; a chunk-needing
+        # prompt ends the batch and starts the chunked path.
+        batch: List = []
+        chunk_next = None
+        while len(batch) < max(1, self.serving.max_prefill_batch):
             action = self.sched.pop_admission()
             if action is None:
                 break
@@ -314,50 +439,154 @@ class Engine:
             if req is None:  # should not happen; free the slot defensively
                 self.sched.release(slot)
                 continue
+            if self._should_chunk(req):
+                chunk_next = (req, slot)
+                break
+            batch.append((req, slot))
+        if batch:
             try:
-                self._do_prefill(req, slot)
+                if len(batch) == 1:
+                    self._do_prefill(*batch[0])
+                else:
+                    self._do_prefill_batch(batch)
             except Exception:
-                # The slot was assigned by the scheduler but slot_req[slot] is
-                # only set on success — release it and notify the client here,
-                # or the capacity leaks and the waiter hangs (run_forever's
-                # _fail_all can't see either).
-                self.sched.release(slot)
-                req.finish_reason = "error"
-                self.metrics.mark_request("error", 0.0)
-                req.out_queue.put(None)
+                # Slots were assigned by the scheduler but slot_req[slot] is
+                # only set on success — release them and notify the clients
+                # here, or the capacity leaks and the waiters hang
+                # (run_forever's _fail_all can't see either).
+                for req, slot in batch:
+                    self.sched.release(slot)
+                    req.finish_reason = "error"
+                    self.metrics.mark_request("error", 0.0)
+                    req.out_queue.put(None)
+                if chunk_next is not None:
+                    req, slot = chunk_next
+                    self.sched.release(slot)
+                    req.finish_reason = "error"
+                    self.metrics.mark_request("error", 0.0)
+                    req.out_queue.put(None)
                 raise
+            if chunk_next is not None:  # chunking starts next step
+                self._chunk = {"req": chunk_next[0], "slot": chunk_next[1],
+                               "off": 0}
+                self._chunk_yield = False
+            return True
+        if chunk_next is not None:
+            self._chunk = {"req": chunk_next[0], "slot": chunk_next[1],
+                           "off": 0}
+            self._advance_chunk()
+            self._chunk_yield = True
             return True
         if self._active_slots():
             self._do_decode()
             return True
         return False
 
+    def _activate(self, req: Request, slot: int, token: int):
+        """Shared post-prefill bookkeeping: slot state + TTFT + first token."""
+        now = time.monotonic()
+        req.t_first_token = now
+        self.metrics.ttft.observe(now - req.t_submit)
+        self.metrics.prompt_tokens.inc(len(req.prompt_ids))
+        self.slot_req[slot] = req
+        self.lengths[slot] = len(req.prompt_ids)
+        self.temps[slot] = req.temperature
+        self.top_ks[slot] = req.top_k
+        self.top_ps[slot] = req.top_p
+        self.sched.note_prefill(slot, len(req.prompt_ids))
+        self.metrics.active_requests.set(len(self._active_slots()))
+        self._emit(slot, token)
+
     def _do_prefill(self, req: Request, slot: int):
         ids = req.prompt_ids
         bucket = self._bucket_for(len(ids))
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :len(ids)] = ids
+        t0 = time.monotonic()
         self.cache, token = prefill_step(
             self.cfg, self.params, self.cache,
             jnp.asarray(tokens), jnp.int32(len(ids)), jnp.int32(slot),
             self._next_rng(), jnp.float32(req.temperature),
             jnp.int32(req.top_k), jnp.float32(req.top_p))
-        token = int(token)
-        now = time.monotonic()
-        req.t_first_token = now
-        self.metrics.ttft.observe(now - req.t_submit)
-        self.metrics.prompt_tokens.inc(len(ids))
+        token = int(token)  # device sync
+        self.metrics.device_busy_seconds.inc(time.monotonic() - t0)
+        self._activate(req, slot, token)
 
-        self.slot_req[slot] = req
-        self.lengths[slot] = len(ids)
-        self.temps[slot] = req.temperature
-        self.top_ks[slot] = req.top_k
-        self.top_ps[slot] = req.top_p
-        self.sched.note_prefill(slot, len(ids))
-        self.metrics.active_requests.set(len(self._active_slots()))
-        self._emit(slot, token)
+    def _do_prefill_batch(self, batch: List):
+        """Prefill N waiting prompts in one dispatch (rows padded to a power
+        of two, lengths to the largest member's bucket)."""
+        n_bucket = 1
+        while n_bucket < len(batch):
+            n_bucket *= 2
+        t_bucket = self._bucket_for(max(len(r.prompt_ids) for r, _ in batch))
+        tokens = np.zeros((n_bucket, t_bucket), np.int32)
+        true_lens = np.ones(n_bucket, np.int32)
+        # padding rows scatter to slot index == num_slots: dropped (OOB)
+        slots = np.full(n_bucket, self.num_slots, np.int32)
+        temps = np.zeros(n_bucket, np.float32)
+        top_ks = np.zeros(n_bucket, np.int32)
+        top_ps = np.ones(n_bucket, np.float32)
+        for i, (req, slot) in enumerate(batch):
+            ids = req.prompt_ids
+            tokens[i, :len(ids)] = ids
+            true_lens[i] = len(ids)
+            slots[i] = slot
+            temps[i] = req.temperature
+            top_ks[i] = req.top_k
+            top_ps[i] = req.top_p
+        t0 = time.monotonic()
+        self.cache, toks = prefill_batch_step(
+            self.cfg, self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(true_lens), jnp.asarray(slots), self._next_rng(),
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps))
+        toks = np.asarray(toks)  # device sync
+        self.metrics.device_busy_seconds.inc(time.monotonic() - t0)
+        for i, (req, slot) in enumerate(batch):
+            self._activate(req, slot, int(toks[i]))
 
-    def _do_decode(self):
+    def _advance_chunk(self):
+        """Dispatch the next chunk of the in-progress chunked prefill."""
+        st = self._chunk
+        req, slot = st["req"], st["slot"]
+        if req.cancelled:
+            self._chunk = None
+            self.sched.release(slot)
+            req.finish_reason = "cancelled"
+            self.metrics.mark_request("cancelled",
+                                      time.monotonic() - req.t_submit)
+            req.out_queue.put(None)
+            return
+        C = self.serving.prefill_chunk
+        ids = req.prompt_ids
+        off = st["off"]
+        chunk = ids[off:off + C]
+        tokens = np.zeros((1, C), np.int32)
+        tokens[0, :len(chunk)] = chunk
+        t0 = time.monotonic()
+        try:
+            self.cache, token = prefill_chunk_step(
+                self.cfg, self.params, self.cache, jnp.asarray(tokens),
+                jnp.int32(off), jnp.int32(slot), jnp.int32(len(chunk)),
+                self._next_rng(), jnp.float32(req.temperature),
+                jnp.int32(req.top_k), jnp.float32(req.top_p))
+        except Exception:
+            self._chunk = None
+            self.sched.release(slot)
+            req.finish_reason = "error"
+            self.metrics.mark_request("error", 0.0)
+            req.out_queue.put(None)
+            raise
+        self.metrics.device_busy_seconds.inc(time.monotonic() - t0)
+        st["off"] = off + len(chunk)
+        # Interleaved decode dispatches write a (garbage) k/v row for every
+        # slot at its host length; keeping this slot's length at the chunk
+        # frontier means that row is exactly where the NEXT chunk writes.
+        self.lengths[slot] = st["off"]
+        if st["off"] >= len(ids):
+            self._chunk = None
+            self._activate(req, slot, int(token))
+
+    def _do_decode(self, max_horizon: Optional[int] = None):
         t0 = time.monotonic()
         active = self._active_slots()
         # Fused horizon unless a waiting prompt could actually prefill next
@@ -368,15 +597,18 @@ class Engine:
         st = self.sched.stats()
         prefill_possible = st.queue_depth > 0 and st.active_slots < st.num_slots
         horizon = 1 if prefill_possible else max(1, self.serving.decode_horizon)
+        if max_horizon is not None:
+            horizon = min(horizon, max_horizon)
         self.cache, out = decode_steps(
             self.cfg, horizon, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
             self._next_rng(), jnp.asarray(self.temps),
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
-            mesh=self.mesh)
+            mesh=self.mesh, impl=self.serving.attention_impl)
         out = np.asarray(out)  # [horizon, B]
         dt = time.monotonic() - t0
         self.metrics.decode_step_duration.observe(dt / horizon)
+        self.metrics.device_busy_seconds.inc(dt)
         emitted = 0
         for s in range(horizon):
             for slot in active:
@@ -450,6 +682,12 @@ class Engine:
     last_error: str = ""
 
     def _fail_all(self, reason: str):
+        if self._chunk is not None:  # fail the half-prefilled request too
+            st, self._chunk = self._chunk, None
+            self.sched.release(st["slot"])
+            st["req"].finish_reason = "error"
+            self.metrics.mark_request("error", 0.0)
+            st["req"].out_queue.put(None)
         for slot, r in enumerate(self.slot_req):
             if r is not None:
                 r.finish_reason = "error"
@@ -483,20 +721,40 @@ class Engine:
     def warmup(self):
         """Pre-compile every program (each prefill bucket + decode) so the first
         real request doesn't pay 20-40s of XLA compile time."""
+        def drain():
+            while (any(s is not None for s in self.slot_req) or self.pending
+                   or self._chunk is not None):
+                self.step()
+
         for b in self.buckets:
             r = Request(prompt_ids=[0] * min(b, self.max_len - 2),
                         max_tokens=1, ignore_eos=True)
             self.submit(r)
-            while any(s is not None for s in self.slot_req) or self.pending:
-                self.step()
+            drain()
+        # Batched-prefill program for the full batch width at the smallest
+        # bucket (the burst-of-short-prompts case the batching exists for;
+        # other (N, T) combos compile lazily on first use).
+        nb = min(self.serving.max_prefill_batch, self.num_slots)
+        if nb > 1:
+            rs = [Request(prompt_ids=[0] * 4, max_tokens=1, ignore_eos=True)
+                  for _ in range(nb)]
+            for r in rs:
+                self.submit(r)
+            drain()
+        # Chunk-prefill program (one program serves every chunk).
+        if self.serving.prefill_chunk > 0 \
+                and self.max_len - 2 > self.serving.prefill_chunk:
+            r = Request(prompt_ids=[0] * (self.serving.prefill_chunk + 1),
+                        max_tokens=1, ignore_eos=True)
+            self.submit(r)
+            drain()
         # compile the fused decode program too (horizon path)
         horizon = max(1, self.serving.decode_horizon)
         if horizon > 1:
             r = Request(prompt_ids=[0] * 4, max_tokens=horizon + 1,
                         ignore_eos=True)
             self.submit(r)
-            while any(s is not None for s in self.slot_req) or self.pending:
-                self.step()
+            drain()
         # The horizon=1 decode variant (selected whenever a prefill is
         # possible) is a distinct compiled program (n_steps is static);
         # compile it now so the first decode overlapping a queued request
@@ -508,4 +766,4 @@ class Engine:
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
             self._next_rng(), jnp.asarray(self.temps),
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
-            mesh=self.mesh)
+            mesh=self.mesh, impl=self.serving.attention_impl)

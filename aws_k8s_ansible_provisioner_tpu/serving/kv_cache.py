@@ -70,6 +70,48 @@ def write_prompt(cache_l: dict, slot: jnp.ndarray, k: jnp.ndarray,
     }
 
 
+def write_prompts(cache_l: dict, slots: jnp.ndarray, k: jnp.ndarray,
+                  v: jnp.ndarray) -> dict:
+    """Batched prompt write: N prompts into N slots in one scatter.
+
+    cache_l: {'k','v': [num_slots, Hkv, max_len, D]}; slots: [N] int32;
+    k/v: [N, T, Hkv, D]. Rows whose slot index is out of range (the padding
+    rows a power-of-two prefill batch adds) are DROPPED by the scatter —
+    mode='drop' makes that contract explicit rather than implicit.
+    """
+    kt = jnp.swapaxes(k, 1, 2)  # [N, Hkv, T, D]
+    vt = jnp.swapaxes(v, 1, 2)
+    T = k.shape[1]
+    return {
+        "k": cache_l["k"].at[slots, :, :T].set(kt, mode="drop"),
+        "v": cache_l["v"].at[slots, :, :T].set(vt, mode="drop"),
+    }
+
+
+def write_chunk(cache_l: dict, slot: jnp.ndarray, start: jnp.ndarray,
+                k: jnp.ndarray, v: jnp.ndarray) -> dict:
+    """Write one prefill CHUNK's K/V rows [start, start+C) into a slot.
+
+    cache_l: {'k','v': [num_slots, Hkv, max_len, D]}; slot/start scalars;
+    k/v: [1, C, Hkv, D]. A per-row scatter with mode='drop' — NOT
+    dynamic_update_slice, whose out-of-bounds clamping would silently SHIFT a
+    final chunk that pokes past max_len backward over earlier chunks'
+    rows (when prefill_chunk doesn't divide the window). With the scatter,
+    every valid row lands at its exact position and rows >= max_len drop.
+    Rows past the chunk's true length (final-chunk padding) land beyond the
+    sequence's final length and are never read (decode masks by length).
+    """
+    C = k.shape[1]
+    rows = start + jnp.arange(C)                  # [C]
+    # Advanced indices (scalar slot, row vector) separated by the head slice
+    # broadcast to the FRONT: the update target is [C, Hkv, D] — exactly the
+    # incoming chunk's layout, no transpose needed.
+    return {
+        "k": cache_l["k"].at[slot, :, rows].set(k[0], mode="drop"),
+        "v": cache_l["v"].at[slot, :, rows].set(v[0], mode="drop"),
+    }
+
+
 def write_token(cache_l: dict, lengths: jnp.ndarray, k: jnp.ndarray,
                 v: jnp.ndarray) -> dict:
     """Scatter one new token per slot at its current length (single layer slice).

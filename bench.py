@@ -1,37 +1,126 @@
 """Headline benchmark: Qwen3-0.6B decode throughput through the serving engine.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-This is the BASELINE.json metric ("Qwen3-0.6B tokens/sec/chip"). The reference
-publishes no numbers (BASELINE.md); the comparison bar is the implicit "≥ 1× L4
-tokens/sec" north star. L4_BASELINE_TOKS below is our documented estimate of
-vLLM Qwen3-0.6B batched decode on the reference's 1× L4 (g6.4xlarge):
-L4 HBM bandwidth is ~300 GB/s and batched decode of a 1.2 GB bf16 model is
-bandwidth-bound at ≤250 fwd/s ⇒ ~32-batch ceiling ≈ 8 k tok/s, with realistic
-vLLM efficiency ~30-40% ⇒ ~2.5 k tok/s. vs_baseline = measured / 2500.
+This is the BASELINE.json metric ("Qwen3-0.6B tokens/sec/chip; p50 TTFT").
+The reference publishes no numbers (BASELINE.md); the comparison bar is the
+implicit ">= 1x L4 tokens/sec" north star. L4_BASELINE_TOKS below is our
+documented estimate of vLLM Qwen3-0.6B batched decode on the reference's
+1x L4 (g6.4xlarge): L4 HBM bandwidth is ~300 GB/s and batched decode of a
+1.2 GB bf16 model is bandwidth-bound at <=250 fwd/s => ~32-batch ceiling
+~= 8k tok/s, with realistic vLLM efficiency ~30-40% => ~2.5k tok/s.
+vs_baseline = measured / 2500.
 
 Measures the REAL serving path (Engine.step: host scheduling + jitted prefill/
 decode with donated KV cache), not a stripped microbench.
+
+Robustness (round-1 postmortem): BENCH_r01 died at `jax.devices()` with a
+transient "TPU backend setup/compile error (Unavailable)" before measuring
+anything. A failed JAX backend init is cached for the life of the process, so
+retries must happen in FRESH subprocesses. This file therefore runs as a thin
+parent orchestrator (imports no jax):
+
+  1. up to TPU_TRIES attempts of `python bench.py --measure` with the
+     environment's default platform (the real chip), bounded by a timeout;
+  2. on persistent failure, one explicit `JAX_PLATFORMS=cpu` fallback so the
+     round still gets a number (clearly marked "platform": "cpu");
+  3. if even that fails, a JSON line with an "error" field — never a bare
+     traceback as the only output.
+
+The measurement child also records the RESOLVED attention impl
+("attention_impl": "pallas"|"xla") so a number can never silently measure the
+XLA fallback while claiming to be the Pallas path.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
 L4_BASELINE_TOKS = 2500.0
+TPU_TRIES = 3
+TPU_TIMEOUT_S = 1200        # backend init alone can take minutes over the tunnel
+CPU_TIMEOUT_S = 1200
+RETRY_BACKOFF_S = 15
+
+
+# ---------------------------------------------------------------------------
+# Parent: subprocess orchestration (no jax imported here)
+# ---------------------------------------------------------------------------
+
+
+def _run_child(env_overrides: dict, timeout: float):
+    """One measurement attempt in a fresh process. Returns (json_dict|None, err)."""
+    env = dict(os.environ)
+    env.update(env_overrides)
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--measure"],
+            capture_output=True, text=True, timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return None, f"timed out after {timeout}s"
+    for line in reversed((p.stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+                if "metric" in d:
+                    return d, None
+            except (ValueError, TypeError):
+                pass
+    tail = ((p.stderr or "") + (p.stdout or "")).strip()[-600:]
+    return None, f"rc={p.returncode}: {tail}"
 
 
 def main() -> None:
+    errors = []
+    for attempt in range(1, TPU_TRIES + 1):
+        result, err = _run_child({}, TPU_TIMEOUT_S)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        errors.append(f"attempt {attempt} (default platform): {err}")
+        sys.stderr.write(f"bench: {errors[-1]}\n")
+        if attempt < TPU_TRIES:  # no pointless backoff before the fallback
+            time.sleep(RETRY_BACKOFF_S * attempt)
+    # Persistent accelerator failure: measure on CPU so the round still has a
+    # (clearly labeled) number, and carry the TPU error for the record.
+    result, err = _run_child({"JAX_PLATFORMS": "cpu"}, CPU_TIMEOUT_S)
+    if result is not None:
+        result["error"] = "tpu backend unavailable; cpu fallback measured. " \
+            + " | ".join(e[:200] for e in errors)
+        print(json.dumps(result))
+        return
+    errors.append(f"cpu fallback: {err}")
+    print(json.dumps({
+        "metric": "qwen3-0.6b decode tokens/sec/chip",
+        "value": 0.0,
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "error": " | ".join(e[:300] for e in errors),
+    }))
+
+
+# ---------------------------------------------------------------------------
+# Child: the actual measurement (fresh process per attempt)
+# ---------------------------------------------------------------------------
+
+
+def measure() -> None:
+    import jax
+    import jax.numpy as jnp
+
     from aws_k8s_ansible_provisioner_tpu.config import QWEN3_0_6B, ServingConfig
     from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+    from aws_k8s_ansible_provisioner_tpu.ops.attention import resolve_impl
     from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
+    impl = resolve_impl("auto")
 
     cfg = QWEN3_0_6B
     serving = ServingConfig(
@@ -45,16 +134,22 @@ def main() -> None:
     )
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.bfloat16)
     engine = Engine(cfg, params, serving)
+    engine.warmup()   # compile every program outside the measured windows
 
     # Fill every decode slot with a short prompt; never stop on eos/budget.
     n_slots = serving.max_decode_slots
     gen_budget = serving.max_cache_len - 64
+    reqs = []
     for i in range(n_slots):
-        engine.submit(Request(prompt_ids=[(7 * i + 3) % 1000 + 10] * 16,
-                              max_tokens=gen_budget, ignore_eos=True))
-    while engine.pending:  # prefills (compiles bucket-32 + decode programs)
+        reqs.append(engine.submit(
+            Request(prompt_ids=[(7 * i + 3) % 1000 + 10] * 16,
+                    max_tokens=gen_budget, ignore_eos=True)))
+    while engine.pending:
         engine.step()
-    # Warm the decode program.
+    # TTFT p50 under the burst (all programs pre-compiled by warmup).
+    ttfts = sorted(r.t_first_token - r.t_submit for r in reqs)
+    ttft_p50_ms = 1e3 * ttfts[len(ttfts) // 2]
+    # Warm the decode program path (first decode after prefills).
     for _ in range(3):
         engine.step()
 
@@ -77,13 +172,26 @@ def main() -> None:
     toks = engine.metrics.generated_tokens.total() - toks0
     assert toks > 0, "no tokens generated in timed window"
     tps = toks / dt
-    print(json.dumps({
+    out = {
         "metric": f"qwen3-0.6b decode tokens/sec/chip (batch={n_slots}, {platform})",
         "value": round(tps, 2),
         "unit": "tokens/sec",
         "vs_baseline": round(tps / L4_BASELINE_TOKS, 3),
-    }))
+        "platform": platform,
+        "attention_impl": impl,
+        "ttft_p50_ms": round(ttft_p50_ms, 2),
+        "batch": n_slots,
+        "decode_horizon": horizon,
+        "timed_tokens": int(toks),
+    }
+    if on_tpu and impl != "pallas":
+        out["warning"] = ("pallas kernel not selected on tpu — number measures "
+                          "the XLA fallback")
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if "--measure" in sys.argv:
+        measure()
+    else:
+        main()

@@ -3,13 +3,20 @@
  *
  * Native parallel of the DCGM exporter role in the reference stack (Go/C++
  * component scraped on a named port, reference kubernetes-single-node.yaml:
- * 480-504 and otel-observability-setup.yaml:393-468). Output format is
- * byte-compatible with the Python module
- * aws_k8s_ansible_provisioner_tpu/k8s/metrics_exporter.py (same families,
- * same labels) so either binary can back the DaemonSet: this one is the
- * minimal-footprint mode (no Python/JAX in the container, ~100 KB static
- * binary, near-zero RSS), the Python one additionally reads HBM telemetry
- * through a live JAX runtime.
+ * 480-504 and otel-observability-setup.yaml:393-468). Output format matches
+ * the Python module aws_k8s_ansible_provisioner_tpu/k8s/metrics_exporter.py
+ * (same families, same labels — parity-tested) so either binary can back the
+ * DaemonSet: this one is the minimal-footprint mode (no Python/JAX in the
+ * container, ~100 KB binary, near-zero RSS).
+ *
+ * Telemetry sources (the chips belong to the ENGINE process, so telemetry
+ * must cross the process boundary):
+ *   1. the engine's /metrics endpoint (--engine-endpoint, default
+ *      127.0.0.1:8000): per-chip tpu_hbm_* gauges pass through, and
+ *      tpu_duty_cycle_percent is derived from the rate of
+ *      tpu_serve_device_busy_seconds_total between successive scrapes;
+ *   2. device-node enumeration (/dev/accel*) — inventory with zero gauges
+ *      when no engine answers.
  *
  * Plain POSIX sockets; single-threaded accept loop (a scrape every 5s is the
  * whole load profile). Build: `make -C native exporter`.
@@ -17,14 +24,18 @@
 
 #include <arpa/inet.h>
 #include <dirent.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <signal.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/time.h>
+#include <time.h>
 #include <unistd.h>
 
+#include <map>
 #include <string>
 #include <vector>
 
@@ -61,8 +72,142 @@ std::vector<std::string> DiscoverChips() {
   return chips;
 }
 
+// --- engine /metrics scrape (cross-process telemetry source) ---------------
+
+// One chip's telemetry row.
+struct ChipStat {
+  std::string chip;
+  double hbm_used = 0, hbm_capacity = 0, duty = 0, tensorcore = 0;
+};
+
+// Minimal HTTP GET: returns body or "" on any failure.
+std::string HttpGet(const std::string& host, int port, const char* path,
+                    int timeout_ms) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return "";
+  timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    hostent* he = gethostbyname(host.c_str());
+    if (!he) { close(fd); return ""; }
+    memcpy(&addr.sin_addr, he->h_addr, sizeof(addr.sin_addr));
+  }
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return "";
+  }
+  std::string req = std::string("GET ") + path + " HTTP/1.0\r\nHost: " + host +
+                    "\r\nConnection: close\r\n\r\n";
+  if (write(fd, req.data(), req.size()) < 0) { close(fd); return ""; }
+  std::string resp;
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof(buf))) > 0) resp.append(buf, n);
+  close(fd);
+  size_t hdr_end = resp.find("\r\n\r\n");
+  return hdr_end == std::string::npos ? "" : resp.substr(hdr_end + 4);
+}
+
+// Parse `name{chip="N",...} value` SAMPLE lines for one family. Iterates
+// line-by-line from each line START (a substring find() would also land
+// inside `# HELP <family> ...` comment lines and fabricate a phantom
+// chip="0" sample from atof of a help word — review r2 #3).
+std::map<std::string, double> ParseFamily(const std::string& body,
+                                          const std::string& family) {
+  std::map<std::string, double> out;
+  size_t start = 0;
+  while (start < body.size()) {
+    size_t eol = body.find('\n', start);
+    size_t len = (eol == std::string::npos ? body.size() : eol) - start;
+    std::string line = body.substr(start, len);
+    start = (eol == std::string::npos) ? body.size() : eol + 1;
+    if (line.compare(0, family.size(), family) != 0) continue;
+    char next = line.size() > family.size() ? line[family.size()] : '\0';
+    if (next != '{' && next != ' ') continue;  // a longer family name
+    std::string chip = "0";
+    size_t cpos = line.find("chip=\"");
+    if (cpos != std::string::npos) {
+      size_t cend = line.find('"', cpos + 6);
+      if (cend != std::string::npos) chip = line.substr(cpos + 6, cend - cpos - 6);
+    }
+    size_t sp = line.rfind(' ');
+    if (sp == std::string::npos) continue;
+    out[chip] = atof(line.c_str() + sp + 1);
+  }
+  return out;
+}
+
+// Duty-cycle state: previous busy-seconds reading per process lifetime.
+double g_prev_busy = -1;
+double g_prev_t = 0;
+std::string g_engine_host = "127.0.0.1";
+int g_engine_port = 8000;
+
+double MonotonicSeconds() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+// Engine-scrape source: fills chips + duty; false if no engine answered.
+bool PollEngine(std::vector<ChipStat>* chips) {
+  std::string body = HttpGet(g_engine_host, g_engine_port, "/metrics", 2000);
+  if (body.empty()) return false;
+  std::map<std::string, double> busy =
+      ParseFamily(body, "tpu_serve_device_busy_seconds_total");
+  if (busy.empty()) return false;
+  double total = 0;
+  for (auto& kv : busy) total += kv.second;
+  double now = MonotonicSeconds();
+  double duty = 0;
+  if (g_prev_busy >= 0 && now > g_prev_t) {
+    duty = 100.0 * (total - g_prev_busy) / (now - g_prev_t);
+    if (duty < 0) duty = 0;
+    if (duty > 100) duty = 100;
+  }
+  g_prev_busy = total;
+  g_prev_t = now;
+  std::map<std::string, double> used = ParseFamily(body, "tpu_hbm_used_bytes");
+  std::map<std::string, double> cap =
+      ParseFamily(body, "tpu_hbm_capacity_bytes");
+  std::map<std::string, bool> ids;
+  for (auto& kv : used) ids[kv.first] = true;
+  for (auto& kv : cap) ids[kv.first] = true;
+  if (ids.empty()) {
+    for (const std::string& c : DiscoverChips()) ids[ChipIndex(c)] = true;
+    if (ids.empty()) ids["0"] = true;
+  }
+  for (auto& kv : ids) {
+    ChipStat s;
+    s.chip = kv.first;
+    if (used.count(kv.first)) s.hbm_used = used[kv.first];
+    if (cap.count(kv.first)) s.hbm_capacity = cap[kv.first];
+    s.duty = duty;
+    chips->push_back(s);
+  }
+  return true;
+}
+
+std::string FormatG(double v) {
+  char buf[64];
+  snprintf(buf, sizeof(buf), "%g", v);
+  return buf;
+}
+
 std::string RenderMetrics() {
-  std::vector<std::string> chips = DiscoverChips();
+  std::vector<ChipStat> chips;
+  if (!PollEngine(&chips)) {
+    // Device-node enumeration only: gauges 0, inventory + liveness intact.
+    for (const std::string& c : DiscoverChips()) {
+      ChipStat s;
+      s.chip = ChipIndex(c);
+      chips.push_back(s);
+    }
+  }
   std::string out;
   out += "# HELP tpu_exporter_up TPU metrics exporter liveness\n";
   out += "# TYPE tpu_exporter_up gauge\n";
@@ -70,22 +215,25 @@ std::string RenderMetrics() {
   out += "# HELP tpu_chips_total TPU chips visible on this host\n";
   out += "# TYPE tpu_chips_total gauge\n";
   out += "tpu_chips_total " + std::to_string(chips.size()) + "\n";
-  struct Family { const char* name; const char* help; };
+  struct Family {
+    const char* name;
+    const char* help;
+    double ChipStat::*field;
+  };
   const Family families[] = {
-      {"tpu_hbm_used_bytes", "HBM bytes in use"},
-      {"tpu_hbm_capacity_bytes", "HBM capacity in bytes"},
-      {"tpu_duty_cycle_percent", "Accelerator busy percent"},
-      {"tpu_tensorcore_utilization_percent", "MXU utilization percent"},
+      {"tpu_hbm_used_bytes", "HBM bytes in use", &ChipStat::hbm_used},
+      {"tpu_hbm_capacity_bytes", "HBM capacity in bytes",
+       &ChipStat::hbm_capacity},
+      {"tpu_duty_cycle_percent", "Accelerator busy percent", &ChipStat::duty},
+      {"tpu_tensorcore_utilization_percent", "MXU utilization percent",
+       &ChipStat::tensorcore},
   };
   for (const Family& f : families) {
     out += std::string("# HELP ") + f.name + " " + f.help + "\n";
     out += std::string("# TYPE ") + f.name + " gauge\n";
-    for (const std::string& chip : chips) {
-      // Device-node enumeration only (runtime-independent mode): gauges are 0,
-      // which keeps the scrape target and chip inventory alive; the Python
-      // exporter fills real HBM numbers when it owns the runtime.
-      out += std::string(f.name) + "{chip=\"" + ChipIndex(chip) +
-             "\",kind=\"tpu\"} 0\n";
+    for (const ChipStat& s : chips) {
+      out += std::string(f.name) + "{chip=\"" + s.chip + "\",kind=\"tpu\"} " +
+             FormatG(s.*(f.field)) + "\n";
     }
   }
   return out;
@@ -111,6 +259,14 @@ int main(int argc, char** argv) {
   int port = 9400;
   for (int i = 1; i + 1 < argc; i += 2) {
     if (strcmp(argv[i], "--port") == 0) port = atoi(argv[i + 1]);
+    if (strcmp(argv[i], "--engine-endpoint") == 0) {
+      std::string ep = argv[i + 1];
+      size_t colon = ep.rfind(':');
+      if (colon != std::string::npos) {
+        g_engine_host = ep.substr(0, colon);
+        g_engine_port = atoi(ep.c_str() + colon + 1);
+      }
+    }
   }
   signal(SIGPIPE, SIG_IGN);
 

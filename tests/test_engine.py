@@ -164,15 +164,27 @@ def test_sampling_reproducible_and_bounded(setup):
     assert outs[0] == outs[1]
 
 
-def test_long_prompt_truncated_to_budget(setup):
+def test_long_prompt_rejected_not_truncated(setup):
+    """Oversized prompt raises ContextLengthExceeded (VERDICT r1: silent
+    tail-truncation served an answer to a different question)."""
+    from aws_k8s_ansible_provisioner_tpu.serving.engine import (
+        ContextLengthExceeded)
+
     cfg, params, serving = setup
     engine = Engine(cfg, params, serving)
     prompt = list(np.random.default_rng(4).integers(2, cfg.vocab_size, 500))
     req = Request(prompt_ids=[int(x) for x in prompt], max_tokens=4,
                   ignore_eos=True)
-    engine.submit(req)
+    with pytest.raises(ContextLengthExceeded) as ei:
+        engine.submit(req)
+    assert ei.value.n_prompt == 500
+    assert ei.value.limit == engine.prompt_limit
+    # a fitting prompt still serves
+    ok = Request(prompt_ids=[int(x) for x in prompt[:engine.prompt_limit]],
+                 max_tokens=4, ignore_eos=True)
+    engine.submit(ok)
     run_engine(engine, [])
-    assert len(req.generated) == 4  # completed despite oversized prompt
+    assert len(ok.generated) == 4
 
 
 def test_cancel_frees_slot(setup):

@@ -1,0 +1,208 @@
+"""Batched + chunked prefill (VERDICT r1 missing #4).
+
+Round 1 prefilled exactly one prompt per dispatch and a prefill displaced a
+decode step — N waiting prompts cost N serialized dispatches during which all
+decode slots stalled. These tests pin the two fixes:
+
+- **batched prefill**: a burst of waiting prompts shares one dispatch, with
+  greedy TOKEN PARITY against the one-at-a-time path;
+- **chunked prefill**: a long prompt prefills in fixed-size chunks with decode
+  steps interleaved, so in-flight streams demonstrably progress DURING the
+  prefill (the vLLM behavior inside the reference's serving pods).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    serving = ServingConfig(max_decode_slots=4, max_cache_len=64,
+                            prefill_buckets=(8, 16, 32), dtype="float32")
+    return cfg, params, serving
+
+
+def _run_all(engine, reqs):
+    for r in reqs:
+        engine.submit(r)
+    for _ in range(10000):
+        if not engine.step():
+            break
+    return [r.generated for r in reqs]
+
+
+def _mk_reqs(cfg, lens, max_tokens=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return [Request(prompt_ids=rng.integers(2, cfg.vocab_size, n).tolist(),
+                    max_tokens=max_tokens, ignore_eos=True) for n in lens]
+
+
+def test_batched_prefill_token_parity(setup):
+    """4 prompts through one batched dispatch == one-at-a-time prefill."""
+    cfg, params, serving = setup
+    sequential = dataclasses.replace(serving, max_prefill_batch=1)
+
+    a = Engine(cfg, params, sequential)
+    expected = _run_all(a, _mk_reqs(cfg, (3, 7, 12, 5)))
+
+    b = Engine(cfg, params, serving)  # max_prefill_batch=4 default
+    got = _run_all(b, _mk_reqs(cfg, (3, 7, 12, 5)))
+    assert got == expected
+
+
+def test_batched_prefill_is_one_dispatch(setup):
+    """All waiting prompts get their first token after a SINGLE step()."""
+    cfg, params, serving = setup
+    engine = Engine(cfg, params, serving)
+    reqs = _mk_reqs(cfg, (3, 4, 5, 6), max_tokens=4, seed=1)
+    for r in reqs:
+        engine.submit(r)
+    assert engine.step()
+    assert all(len(r.generated) == 1 for r in reqs), \
+        "batched prefill did not emit every first token in one step"
+
+
+def test_batched_prefill_more_than_batch_queue(setup):
+    """6 requests, batch width 4, 4 slots: all complete with parity."""
+    cfg, params, serving = setup
+    lens = (3, 4, 5, 6, 7, 8)
+    a = Engine(cfg, params, dataclasses.replace(serving, max_prefill_batch=1))
+    expected = _run_all(a, _mk_reqs(cfg, lens, max_tokens=4, seed=2))
+    b = Engine(cfg, params, serving)
+    got = _run_all(b, _mk_reqs(cfg, lens, max_tokens=4, seed=2))
+    assert got == expected
+
+
+def test_chunked_prefill_token_parity(setup):
+    """A prompt prefilled in 8-token chunks generates EXACTLY the tokens of
+    the whole-prompt (bucketed) prefill — the chunk attention mask and
+    cache-prefix reads must be equivalent to one causal pass."""
+    cfg, params, serving = setup
+    chunked = dataclasses.replace(serving, prefill_chunk=8)
+    for plen in (9, 16, 23, 30):
+        reqs_a = _mk_reqs(cfg, (plen,), max_tokens=8, seed=plen)
+        expected = _run_all(Engine(cfg, params, serving), reqs_a)
+        reqs_b = _mk_reqs(cfg, (plen,), max_tokens=8, seed=plen)
+        got = _run_all(Engine(cfg, params, chunked), reqs_b)
+        assert got == expected, f"chunked prefill diverged at prompt len {plen}"
+
+
+def test_decode_progresses_during_chunked_prefill(setup):
+    """THE point of chunking: an in-flight stream gains tokens while a long
+    prompt is still prefilling."""
+    cfg, params, serving = setup
+    chunked = dataclasses.replace(serving, prefill_chunk=4, decode_horizon=1)
+    engine = Engine(cfg, params, chunked)
+    # stream A: active and decoding
+    a = Request(prompt_ids=[5, 6, 7], max_tokens=50, ignore_eos=True)
+    engine.submit(a)
+    engine.step()            # prefill A
+    engine.step()            # one decode
+    # stream B: long prompt -> 8 chunks of 4
+    b = Request(prompt_ids=list(np.random.default_rng(3).integers(
+        2, cfg.vocab_size, 31)), max_tokens=4, ignore_eos=True)
+    engine.submit(b)
+    # step until B's prefill completes (first token emitted)
+    a_before = len(a.generated)
+    for _ in range(100):
+        engine.step()
+        if b.generated:
+            break
+    a_during = len(a.generated) - a_before
+    assert b.generated, "B never finished prefilling"
+    assert a_during >= 3, (
+        f"stream A gained only {a_during} tokens during B's chunked prefill "
+        f"— decode did not interleave")
+
+
+def test_chunked_prompt_beyond_largest_bucket(setup):
+    """Chunking lifts the prompt limit from the largest bucket (32) to the
+    cache window: a 40-token prompt serves instead of 400ing."""
+    cfg, params, serving = setup
+    chunked = dataclasses.replace(serving, prefill_chunk=16)
+    engine = Engine(cfg, params, chunked)
+    assert engine.prompt_limit == engine.max_len - 2
+    req = _mk_reqs(cfg, (40,), max_tokens=4, seed=9)[0]
+    _run_all(engine, [req])
+    assert len(req.generated) == 4
+    # parity with a wider-bucketed unchunked engine on the same prompt
+    wide = dataclasses.replace(serving, prefill_buckets=(8, 16, 32, 64))
+    req2 = _mk_reqs(cfg, (40,), max_tokens=4, seed=9)[0]
+    _run_all(Engine(cfg, params, wide), [req2])
+    assert req.generated == req2.generated
+
+
+def test_chunk_not_dividing_window_no_corruption(setup):
+    """Regression (review r2 #1): with prefill_chunk NOT dividing the cache
+    window, the final chunk of a near-window-length prompt pokes past
+    max_len; a clamped slice write would shift it backward over earlier
+    chunks' rows. The scatter write must keep token parity."""
+    cfg, params, serving = setup            # max_cache_len=64
+    chunked = dataclasses.replace(serving, prefill_chunk=24)   # 24 ∤ 64
+    wide = dataclasses.replace(serving, prefill_buckets=(8, 16, 32, 64))
+    for plen in (60, 61):                   # final chunk spans rows 48..71
+        reqs_a = _mk_reqs(cfg, (plen,), max_tokens=2, seed=100 + plen)
+        expected = _run_all(Engine(cfg, params, wide), reqs_a)
+        reqs_b = _mk_reqs(cfg, (plen,), max_tokens=2, seed=100 + plen)
+        got = _run_all(Engine(cfg, params, chunked), reqs_b)
+        assert got == expected, f"cache corrupted at prompt len {plen}"
+
+
+def test_prompt_between_bucket_and_chunk_size(setup):
+    """Regression (review r2 #2): prefill_chunk larger than the largest
+    bucket + a prompt in between must take the chunked path, not crash the
+    whole-prompt path's numpy broadcast."""
+    cfg, params, serving = setup            # buckets (8, 16, 32)
+    chunked = dataclasses.replace(serving, prefill_chunk=48)
+    engine = Engine(cfg, params, chunked)
+    req = _mk_reqs(cfg, (40,), max_tokens=3, seed=11)[0]   # 32 < 40 <= 48
+    _run_all(engine, [req])
+    assert len(req.generated) == 3
+    # and the engine still serves afterwards (no _fail_all blast)
+    ok = _mk_reqs(cfg, (6,), max_tokens=2, seed=12)[0]
+    _run_all(engine, [ok])
+    assert len(ok.generated) == 2
+
+
+def test_cancel_mid_chunked_prefill_releases_slot(setup):
+    cfg, params, serving = setup
+    chunked = dataclasses.replace(serving, prefill_chunk=4)
+    engine = Engine(cfg, params, chunked)
+    req = _mk_reqs(cfg, (30,), max_tokens=8, seed=4)[0]
+    engine.submit(req)
+    engine.step()            # first chunk dispatched
+    assert engine._chunk is not None
+    engine.cancel(req)
+    for _ in range(5):
+        engine.step()
+    assert engine._chunk is None
+    assert req.finish_reason == "cancelled"
+    assert engine.sched.stats().active_slots == 0
+    assert req.out_queue.get(timeout=5) is None
+    # capacity intact
+    ok = _mk_reqs(cfg, (6,), max_tokens=2, seed=5)[0]
+    _run_all(engine, [ok])
+    assert len(ok.generated) == 2
+
+
+def test_warmup_compiles_batch_and_chunk_paths(setup):
+    cfg, params, serving = setup
+    chunked = dataclasses.replace(serving, prefill_chunk=8)
+    engine = Engine(cfg, params, chunked)
+    engine.warmup()          # must terminate and leave a clean engine
+    assert engine._chunk is None
+    assert not engine.pending
+    assert all(s is None for s in engine.slot_req)
+    req = _mk_reqs(cfg, (20,), max_tokens=3, seed=6)[0]
+    _run_all(engine, [req])
+    assert len(req.generated) == 3

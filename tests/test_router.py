@@ -9,7 +9,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import pytest
 
 from aws_k8s_ansible_provisioner_tpu.serving.router import (
-    BackendPool, RouterHandler,
+    BackendPool, RouterHandler, RouterMetrics,
 )
 
 
@@ -53,14 +53,15 @@ def backend():
 @pytest.fixture()
 def router(backend):
     pool = BackendPool(f"127.0.0.1:{backend.server_port}")
-    old = RouterHandler.pool
+    old, oldm = RouterHandler.pool, RouterHandler.metrics
     RouterHandler.pool = pool
+    RouterHandler.metrics = RouterMetrics()
     srv = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     yield srv
     srv.shutdown()
-    RouterHandler.pool = old
+    RouterHandler.pool, RouterHandler.metrics = old, oldm
 
 
 def _get(port, path):
@@ -101,8 +102,9 @@ def test_router_passes_through_backend_errors(router):
 
 def test_router_503_when_no_backends():
     pool = BackendPool("nonexistent.invalid:9")
-    old = RouterHandler.pool
+    old, oldm = RouterHandler.pool, RouterHandler.metrics
     RouterHandler.pool = pool
+    RouterHandler.metrics = RouterMetrics()
     srv = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -113,7 +115,51 @@ def test_router_503_when_no_backends():
         assert e.code == 503
     finally:
         srv.shutdown()
-        RouterHandler.pool = old
+        RouterHandler.pool, RouterHandler.metrics = old, oldm
+
+
+def test_router_metrics_endpoint(router):
+    _get(router.server_port, "/v1/models")  # generate one relayed request
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.server_port}/metrics", timeout=10) as r:
+        text = r.read().decode()
+        assert r.headers["Content-Type"].startswith("text/plain")
+    assert "tpu_router_requests_total" in text
+    assert 'code="200"' in text
+    assert "tpu_router_backends" in text
+
+
+def test_router_failover_on_connect_refused(backend):
+    """A dead replica (connection refused) fails over — even for POSTs, since
+    nothing was sent yet (ADVICE r1 retry-semantics fix: only connect-phase
+    failures may replay a request with a body)."""
+
+    class DeadFirstPool(BackendPool):
+        def __init__(self):
+            super().__init__(f"127.0.0.1:{backend.server_port}")
+
+        def pick(self):
+            # first candidate: a loopback address with no listener -> refused
+            return ["127.255.255.254", "127.0.0.1"]
+
+    old, oldm = RouterHandler.pool, RouterHandler.metrics
+    RouterHandler.pool = DeadFirstPool()
+    RouterHandler.metrics = RouterMetrics()
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.server_port}/v1/completions",
+            data=json.dumps({"prompt": "hi"}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            body = json.loads(r.read())
+        assert body["echo"]["prompt"] == "hi"  # served by the live replica
+        assert RouterHandler.metrics.failovers.total() >= 1
+    finally:
+        srv.shutdown()
+        RouterHandler.pool, RouterHandler.metrics = old, oldm
 
 
 def test_pool_rotation_and_cooldown():

@@ -220,6 +220,29 @@ def test_nonstream_stop_string_truncates(server):
     assert choice["finish_reason"] == "stop"
 
 
+def test_context_length_exceeded_400(server):
+    """Oversized prompt must be a 400 context_length_exceeded (as the
+    reference's vLLM does) — NOT silently truncated-and-served (VERDICT r1)."""
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/completions",
+              {"model": MODEL_NAME, "prompt": "x" * 500, "max_tokens": 4})
+    assert ei.value.code == 400
+    body = json.loads(ei.value.read())
+    assert body["error"]["code"] == "context_length_exceeded"
+    assert "500" in body["error"]["message"]  # reports the offending length
+
+
+def test_chat_context_length_exceeded_400(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/chat/completions",
+              {"model": MODEL_NAME,
+               "messages": [{"role": "user", "content": "y" * 500}],
+               "max_tokens": 4})
+    assert ei.value.code == 400
+    assert json.loads(ei.value.read())["error"]["code"] == \
+        "context_length_exceeded"
+
+
 def test_debug_profile_captures_trace(server):
     """/debug/profile returns a trace dir after a short capture window
     (SURVEY.md §5: the reference accepts-and-drops traces; ours are real)."""
