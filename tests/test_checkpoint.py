@@ -46,7 +46,7 @@ def test_cached_load_converts_once_then_restores(tmp_path, monkeypatch):
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     calls = {"n": 0}
 
-    def fake_load(checkpoint_dir, cfg_, dtype):
+    def fake_load(checkpoint_dir, cfg_, dtype, **kw):
         calls["n"] += 1
         return params
 
@@ -69,7 +69,7 @@ def test_corrupt_cache_falls_back_to_conversion(tmp_path, monkeypatch):
 
     monkeypatch.setattr(
         "aws_k8s_ansible_provisioner_tpu.models.hf_loader.load_checkpoint",
-        lambda d, c, t: params)
+        lambda d, c, t, **kw: params)
     # Plant a garbage cache dir where orbax expects a checkpoint.
     cache = tmp_path / "jax_cache" / "float32"
     cache.mkdir(parents=True)
@@ -82,7 +82,7 @@ def test_corrupt_cache_falls_back_to_conversion(tmp_path, monkeypatch):
 def test_dtype_separate_caches(tmp_path, monkeypatch):
     cfg = tiny_qwen3()
 
-    def fake_load(checkpoint_dir, cfg_, dtype):
+    def fake_load(checkpoint_dir, cfg_, dtype, **kw):
         return init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
 
     monkeypatch.setattr(
@@ -107,7 +107,7 @@ def test_stale_cache_invalidated_by_source_change(tmp_path, monkeypatch):
     current = {"params": p_old}
     monkeypatch.setattr(
         "aws_k8s_ansible_provisioner_tpu.models.hf_loader.load_checkpoint",
-        lambda d, c, t: current["params"])
+        lambda d, c, t, **kw: current["params"])
 
     st = tmp_path / "model.safetensors"
     st.write_bytes(b"v1")
