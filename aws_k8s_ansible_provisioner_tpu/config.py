@@ -42,6 +42,10 @@ class ModelConfig:
     - ``act``: "silu" → SwiGLU gated MLP; "gelu_new"/"relu" → plain 2-matrix MLP.
     - ``pos_embed``: "rope" or "learned" (OPT: learned absolute positions with
       the family's +2 offset).
+    - ``rope_scaling``: "none" or "llama3" (the Llama-3.1+ frequency-dependent
+      NTK scaling; the remaining ``rope_*`` fields are its parameters — scalar
+      fields rather than a dict so the config stays hashable for jit
+      static-arg use).
     """
 
     name: str
@@ -55,6 +59,11 @@ class ModelConfig:
     max_seq_len: int = 4096
     rope_theta: float = 10000.0
     rotary_pct: float = 1.0
+    rope_scaling: str = "none"
+    rope_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_pos: int = 8192
     norm: str = "rmsnorm"
     norm_eps: float = 1e-6
     qk_norm: bool = False
@@ -66,6 +75,10 @@ class ModelConfig:
     tie_embeddings: bool = False
     bos_token_id: Optional[int] = None
     eos_token_id: int = 0
+    # Additional stop ids (Llama-3 Instruct checkpoints declare a LIST of eos
+    # ids — e.g. <|end_of_text|> plus <|eot_id|>; chat turns end with the
+    # latter). Tuple, not list, so the config stays hashable for jit.
+    extra_eos_token_ids: tuple = ()
     hf_repo: str = ""
 
     @property
@@ -187,12 +200,76 @@ OPT_1_3B = ModelConfig(
     hf_repo="facebook/opt-1.3b",
 )
 
+LLAMA_3_2_1B = ModelConfig(
+    name="meta-llama/Llama-3.2-1B",
+    vocab_size=128256,
+    hidden_size=2048,
+    intermediate_size=8192,
+    num_layers=16,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=64,
+    max_seq_len=131072,
+    rope_theta=500000.0,
+    rope_scaling="llama3",
+    rope_factor=32.0,
+    rope_low_freq_factor=1.0,
+    rope_high_freq_factor=4.0,
+    rope_original_max_pos=8192,
+    tie_embeddings=True,
+    bos_token_id=128000,
+    eos_token_id=128001,
+    hf_repo="meta-llama/Llama-3.2-1B",
+)
+
+LLAMA_3_1_8B = ModelConfig(
+    name="meta-llama/Llama-3.1-8B",
+    vocab_size=128256,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    max_seq_len=131072,
+    rope_theta=500000.0,
+    rope_scaling="llama3",
+    rope_factor=8.0,
+    rope_low_freq_factor=1.0,
+    rope_high_freq_factor=4.0,
+    rope_original_max_pos=8192,
+    tie_embeddings=False,
+    bos_token_id=128000,
+    eos_token_id=128001,
+    hf_repo="meta-llama/Llama-3.1-8B",
+)
+
+TINYLLAMA_1_1B = ModelConfig(
+    name="TinyLlama/TinyLlama-1.1B-Chat-v1.0",
+    vocab_size=32000,
+    hidden_size=2048,
+    intermediate_size=5632,
+    num_layers=22,
+    num_heads=32,
+    num_kv_heads=4,
+    head_dim=64,
+    max_seq_len=2048,
+    rope_theta=10000.0,
+    tie_embeddings=False,
+    bos_token_id=1,
+    eos_token_id=2,
+    hf_repo="TinyLlama/TinyLlama-1.1B-Chat-v1.0",
+)
+
 MODEL_REGISTRY = {
     "Qwen/Qwen3-0.6B": QWEN3_0_6B,
     "Qwen/Qwen3-8B": QWEN3_8B,
     "microsoft/phi-2": PHI_2,
     "facebook/opt-125m": OPT_125M,
     "facebook/opt-1.3b": OPT_1_3B,
+    "meta-llama/Llama-3.2-1B": LLAMA_3_2_1B,
+    "meta-llama/Llama-3.1-8B": LLAMA_3_1_8B,
+    "TinyLlama/TinyLlama-1.1B-Chat-v1.0": TINYLLAMA_1_1B,
 }
 
 
@@ -218,6 +295,31 @@ def tiny_qwen3(**overrides) -> ModelConfig:
         max_seq_len=128,
         rope_theta=1e6,
         qk_norm=True,
+        tie_embeddings=True,
+        eos_token_id=1,
+    )
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+def tiny_llama(**overrides) -> ModelConfig:
+    """A miniature Llama-3-shaped config (GQA, llama3 rope scaling, no qk-norm)."""
+    base = dict(
+        name="tiny-llama",
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_seq_len=256,
+        rope_theta=500000.0,
+        rope_scaling="llama3",
+        rope_factor=8.0,
+        rope_low_freq_factor=1.0,
+        rope_high_freq_factor=4.0,
+        rope_original_max_pos=64,
         tie_embeddings=True,
         eos_token_id=1,
     )

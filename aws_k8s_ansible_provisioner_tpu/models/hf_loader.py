@@ -209,6 +209,43 @@ def config_from_hf_dir(checkpoint_dir: str) -> ModelConfig:
             eos_token_id=(hf.get("eos_token_id") or 0),
             hf_repo=name,
         )
+    if model_type == "llama":
+        rs = hf.get("rope_scaling") or {}
+        rs_type = rs.get("rope_type") or rs.get("type") or "none"
+        if rs_type not in ("none", "llama3", "default"):
+            raise ValueError(f"unsupported llama rope_scaling type {rs_type!r}")
+        eos = hf.get("eos_token_id") or 0
+        eos_list = eos if isinstance(eos, list) else [eos]
+        return ModelConfig(
+            name=name,
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads") or hf["num_attention_heads"],
+            head_dim=hf.get("head_dim") or
+            hf["hidden_size"] // hf["num_attention_heads"],
+            max_seq_len=hf.get("max_position_embeddings", 4096),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rope_scaling="llama3" if rs_type == "llama3" else "none",
+            rope_factor=float(rs.get("factor", 1.0)),
+            rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+            rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+            rope_original_max_pos=int(
+                rs.get("original_max_position_embeddings", 8192)),
+            norm_eps=hf.get("rms_norm_eps", 1e-5),
+            attention_bias=hf.get("attention_bias", False),
+            mlp_bias=hf.get("mlp_bias", False),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            bos_token_id=hf.get("bos_token_id"),
+            # Llama-3 Instruct declares a LIST of eos ids; generation must
+            # stop on ANY of them (chat turns end with <|eot_id|>, which is
+            # NOT the first entry) — the engine checks the whole set.
+            eos_token_id=eos_list[0],
+            extra_eos_token_ids=tuple(eos_list[1:]),
+            hf_repo=name,
+        )
     if model_type == "phi":
         head_dim = hf["hidden_size"] // hf["num_attention_heads"]
         return ModelConfig(

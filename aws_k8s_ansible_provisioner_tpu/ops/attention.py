@@ -63,49 +63,79 @@ def resolve_impl(impl: str = "auto") -> str:
     return impl
 
 
-def make_decode_attend(lengths: jnp.ndarray, impl: str = "auto", mesh=None):
-    """Attend callback for model_forward: writes the new token, then attends.
+def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
+                             mesh=None):
+    """Carry-path decode attend: cache_l is ``(full_cache, layer_idx)``.
 
-    ``lengths`` are the pre-step lengths (position of the incoming token).
+    Used with ``models.layers.model_forward_carry`` — the full stacked cache
+    rides the layer-scan carry, the new token's K/V scatter in place
+    (kv_cache.write_token_layer), and the Pallas kernel reads the selected
+    layer straight out of the full buffer (no per-layer slice copy). The XLA
+    fallback pays one layer-slice copy per layer (fine on CPU, where the
+    tests run it; on TPU the Pallas path is the point).
 
-    With a ``mesh``, the Pallas kernel runs under ``shard_map``: decode
-    attention is (slot, head)-local, so slots shard over ``dp`` and heads over
-    ``tp`` with ZERO collectives — each device runs the kernel on its own
-    cache shard (XLA can't partition a custom call on its own, so without
-    shard_map the kernel would force an all-gather of the cache). The XLA
-    fallback needs no wrapper: GSPMD partitions its einsums directly.
+    Sharding: slots over ``dp``, kv heads over ``tp``, zero collectives —
+    decode attention is (slot, head)-local, so shard_map runs the kernel on
+    each device's own cache shard (XLA can't partition a custom call on its
+    own; without shard_map it would force an all-gather of the cache).
     """
     resolved = resolve_impl(impl)
 
-    def _pallas(q, k, v, lens):
+    def _write_attend(q, ck, cv, knew, vnew, lens, layer):
+        """Per-shard body: in-place row writes + layer-indexed flash attend.
+
+        The writes use the aliased Pallas kernel — NOT a functional scatter —
+        so the multi-GB cache buffers are updated in place even inside the
+        decode scan's carry (XLA copy-insertion materializes full-cache copies
+        around scatters there; see cache_write_row's docstring).
+        """
         from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
 
         interpret = jax.default_backend() != "tpu"
-        return pallas_attention.decode_attend_pallas(q, k, v, lens,
-                                                     interpret=interpret)
+        ck = pallas_attention.cache_write_row(ck, knew, lens, layer,
+                                              interpret=interpret)
+        cv = pallas_attention.cache_write_row(cv, vnew, lens, layer,
+                                              interpret=interpret)
+        ctx = pallas_attention.decode_attend_pallas_layer(
+            q, ck, cv, lens + 1, layer, interpret=interpret)
+        return ctx, ck, cv
 
-    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
-        cache_l = kvc.write_token(cache_l, lengths, k, v)
+    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, tuple]:
+        cache, layer = cache_l
         if resolved == "pallas":
+            knew, vnew = k[:, 0], v[:, 0]
             if mesh is not None:
                 from jax.experimental.shard_map import shard_map
                 from jax.sharding import PartitionSpec as P
 
                 fn = shard_map(
-                    _pallas, mesh=mesh,
-                    in_specs=(P("dp", None, "tp", None),   # q [B,1,Hq,D]
-                              P("dp", "tp", None, None),   # k [B,Hkv,S,D]
-                              P("dp", "tp", None, None),   # v
-                              P("dp")),                    # lengths [B]
-                    out_specs=P("dp", None, "tp", None),
+                    _write_attend, mesh=mesh,
+                    in_specs=(P("dp", None, "tp", None),         # q [B,1,Hq,D]
+                              P(None, "dp", "tp", None, None),   # k [L,B,Hkv,S,D]
+                              P(None, "dp", "tp", None, None),   # v
+                              P("dp", "tp", None),               # knew [B,Hkv,D]
+                              P("dp", "tp", None),               # vnew
+                              P("dp"),                           # lengths [B]
+                              P()),                              # layer scalar
+                    out_specs=(P("dp", None, "tp", None),
+                               P(None, "dp", "tp", None, None),
+                               P(None, "dp", "tp", None, None)),
                     check_rep=False,
                 )
-                ctx = fn(q, cache_l["k"], cache_l["v"], lengths + 1)
+                ctx, ck, cv = fn(q, cache["k"], cache["v"], knew, vnew,
+                                 lengths, layer)
             else:
-                ctx = _pallas(q, cache_l["k"], cache_l["v"], lengths + 1)
+                ctx, ck, cv = _write_attend(q, cache["k"], cache["v"],
+                                            knew, vnew, lengths, layer)
+            cache = {"k": ck, "v": cv}
         else:
-            ctx = decode_attend(q, cache_l["k"], cache_l["v"], lengths + 1)
-        return ctx, cache_l
+            cache = kvc.write_token_layer(cache, layer, lengths, k, v)
+            ck = jax.lax.dynamic_index_in_dim(cache["k"], layer, 0,
+                                              keepdims=False)
+            cv = jax.lax.dynamic_index_in_dim(cache["v"], layer, 0,
+                                              keepdims=False)
+            ctx = decode_attend(q, ck, cv, lengths + 1)
+        return ctx, (cache, layer)
 
     return attend
 

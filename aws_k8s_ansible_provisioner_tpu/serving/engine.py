@@ -40,10 +40,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from aws_k8s_ansible_provisioner_tpu.config import ModelConfig, ServingConfig
-from aws_k8s_ansible_provisioner_tpu.models.layers import model_forward
+from aws_k8s_ansible_provisioner_tpu.models.layers import (
+    model_forward,
+    model_forward_carry,
+)
 from aws_k8s_ansible_provisioner_tpu.ops.attention import (
     make_chunk_prefill_attend,
-    make_decode_attend,
+    make_decode_attend_carry,
     make_prefill_attend,
     make_prefill_attend_batch,
 )
@@ -184,16 +187,23 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
     the KV cache resident in HBM across all substeps (donated carry). The
     scheduler only uses a horizon > 1 when no prefill is waiting, so TTFT is
     not taxed. Slots that hit a stop condition mid-horizon generate a few
-    surplus tokens which the host discards; writes past ``max_len`` are
-    dropped by XLA's out-of-bounds scatter semantics (never corrupt memory).
+    surplus tokens which the host discards; surplus K/V writes past
+    ``max_len`` CLAMP onto the slot's last row (cache_write_row's block-index
+    clamp) — harmless garbage, because the row is masked by the slot's length
+    until the moment a later decode step writes that row itself, immediately
+    before the first attend that could read it.
     """
 
     def body(carry, rng_i):
         cache, tok, lens = carry
         positions = lens[:, None]
-        attend = make_decode_attend(lens, impl=impl, mesh=mesh)
-        logits, cache = model_forward(params, cfg, tok[:, None], positions,
-                                      cache, attend)
+        # Carry-path forward: the cache stays in place in the scan carry and
+        # attention reads it layer-indexed — no per-layer xs→ys copy (the
+        # copy cost dominated decode at ~24 ms/token on v5e; see
+        # model_forward_carry's docstring).
+        attend = make_decode_attend_carry(lens, impl=impl, mesh=mesh)
+        logits, cache = model_forward_carry(params, cfg, tok[:, None],
+                                            positions, cache, attend)
         nxt = sample(logits[:, 0, :], rng_i, temperature, top_k, top_p)
         return (cache, nxt, lens + 1), nxt
 
@@ -217,6 +227,13 @@ class Engine:
         self.serving = serving
         self.eos_token_id = cfg.eos_token_id if eos_token_id is None \
             else eos_token_id
+        # Any member stops generation (Llama-3 Instruct ships several eos
+        # ids; chat turns end with <|eot_id|>, not the primary eos). A
+        # constructor override (e.g. the tokenizer's eos) EXTENDS the config's
+        # set — replacing it would evict <|end_of_text|> when the tokenizer
+        # declares <|eot_id|>.
+        self._eos_set = ({self.eos_token_id, cfg.eos_token_id}
+                         | set(cfg.extra_eos_token_ids))
         self.num_slots = serving.max_decode_slots
         # Round the cache window up to a 256 multiple: the Pallas decode
         # kernel streams the cache in chunks that must divide the window, and
@@ -634,7 +651,7 @@ class Engine:
         if req.stream:
             req.out_queue.put(token)
 
-        hit_eos = (token == self.eos_token_id) and not req.ignore_eos
+        hit_eos = (token in self._eos_set) and not req.ignore_eos
         out_of_budget = (len(req.generated) >= req.max_tokens
                          or self.lengths[slot] + 1 >= self.max_len)
         if hit_eos or out_of_budget:

@@ -128,6 +128,26 @@ def write_token(cache_l: dict, lengths: jnp.ndarray, k: jnp.ndarray,
     }
 
 
+def write_token_layer(cache: dict, layer: jnp.ndarray, lengths: jnp.ndarray,
+                      k: jnp.ndarray, v: jnp.ndarray) -> dict:
+    """Scatter one new token per slot into the FULL cache at a given layer.
+
+    cache: {'k','v': [L, B, Hkv, S, D]}; layer: scalar int; lengths: [B];
+    k/v: [B, 1, Hkv, D]. This is the carry-path write (see
+    models/layers.model_forward_carry): the cache flows through the layer scan
+    as part of the carry, so this scatter updates the donated buffer IN PLACE —
+    the xs→ys alternative costs a full-cache copy per layer per decode step
+    (~7 GB/token for Qwen3-0.6B at batch 32 — measured 24 ms/token on v5e vs
+    ~4 ms without the copies).
+    """
+    B = k.shape[0]
+    rows = jnp.arange(B)
+    return {
+        "k": cache["k"].at[layer, rows, :, lengths].set(k[:, 0]),
+        "v": cache["v"].at[layer, rows, :, lengths].set(v[:, 0]),
+    }
+
+
 def pages_view(cache: dict, page_size: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Reinterpret the cache as pages: [L, slots*heads*pages_per_stream, page, D].
 

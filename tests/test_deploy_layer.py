@@ -174,7 +174,8 @@ def test_chat_template_configmaps_ship_and_render():
             add_generation_prompt=True)
         assert "hello" in out
         assert "sys" in out
-    assert {"phi-chat-template", "opt-chat-template", "qwen-chat-template"} <= names
+    assert {"phi-chat-template", "opt-chat-template", "qwen-chat-template",
+            "llama-chat-template"} <= names
     rendered = _render_manifest(DEPLOY / "manifests" / "serving.yaml.j2")
     assert "qwen-chat-template" in rendered
 

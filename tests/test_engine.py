@@ -117,6 +117,28 @@ def test_eos_stops_generation(setup):
     assert req.finish_reason == "stop"
 
 
+def test_extra_eos_ids_stop_generation(setup):
+    """Llama-3 Instruct ships a LIST of eos ids; any member must stop the
+    stream (review r2: only eos_token_id[0] was honored, so chat turns never
+    stopped at <|eot_id|>)."""
+    cfg, params, serving = setup
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(2, cfg.vocab_size, 5).tolist()
+    expected = naive_greedy(params, cfg, prompt, 16)
+    stop_at = next((i for i in range(1, len(expected))
+                    if expected[i] not in expected[:i]), None)
+    if stop_at is None:
+        pytest.skip("degenerate stream: all tokens identical")
+    # the stopping id arrives via extra_eos_token_ids, NOT the primary eos
+    cfg2 = cfg.scaled(eos_token_id=cfg.vocab_size - 1,
+                      extra_eos_token_ids=(expected[stop_at],))
+    engine = Engine(cfg2, params, serving)
+    req = Request(prompt_ids=list(prompt), max_tokens=16)
+    run_engine(engine, [req])
+    assert req.generated == expected[:stop_at + 1]
+    assert req.finish_reason == "stop"
+
+
 def test_more_requests_than_slots(setup):
     """Queueing: 6 requests through 4 slots all complete correctly."""
     cfg, params, serving = setup

@@ -12,7 +12,8 @@ import numpy as np
 import pytest
 import jax.numpy as jnp
 
-from aws_k8s_ansible_provisioner_tpu.config import tiny_opt, tiny_phi, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.config import (tiny_llama, tiny_opt,
+                                                    tiny_phi, tiny_qwen3)
 from aws_k8s_ansible_provisioner_tpu.models import convert_state_dict, model_forward
 
 
@@ -64,6 +65,41 @@ def _hf_phi(cfg):
     return PhiForCausalLM(hf_cfg).eval()
 
 
+def _hf_llama(cfg):
+    import torch
+    from transformers import LlamaConfig
+    from transformers.models.llama.modeling_llama import LlamaForCausalLM
+
+    rope_scaling = None
+    if cfg.rope_scaling == "llama3":
+        rope_scaling = {
+            "rope_type": "llama3",
+            "factor": cfg.rope_factor,
+            "low_freq_factor": cfg.rope_low_freq_factor,
+            "high_freq_factor": cfg.rope_high_freq_factor,
+            "original_max_position_embeddings": cfg.rope_original_max_pos,
+        }
+    hf_cfg = LlamaConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        max_position_embeddings=cfg.max_seq_len,
+        rms_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rope_theta,
+        rope_scaling=rope_scaling,
+        tie_word_embeddings=cfg.tie_embeddings,
+        attention_bias=cfg.attention_bias,
+        mlp_bias=cfg.mlp_bias,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    return LlamaForCausalLM(hf_cfg).eval()
+
+
 def _hf_opt(cfg):
     import torch
     from transformers import OPTConfig
@@ -87,12 +123,20 @@ def _hf_opt(cfg):
     return OPTForCausalLM(hf_cfg).eval()
 
 
-@pytest.mark.parametrize("family", ["qwen3", "phi", "opt"])
+@pytest.mark.parametrize("family", ["qwen3", "phi", "opt", "llama",
+                                    "llama_unscaled"])
 def test_logits_match_hf(family):
     import torch
 
     builders = {"qwen3": (tiny_qwen3, _hf_qwen3), "phi": (tiny_phi, _hf_phi),
-                "opt": (tiny_opt, _hf_opt)}
+                "opt": (tiny_opt, _hf_opt),
+                # llama3 rope scaling on and off (TinyLlama/llama-2 style)
+                "llama": (tiny_llama, _hf_llama),
+                "llama_unscaled": (
+                    lambda: tiny_llama(rope_scaling="none",
+                                       rope_theta=10000.0,
+                                       tie_embeddings=False),
+                    _hf_llama)}
     mk_cfg, mk_model = builders[family]
     cfg = mk_cfg()
     model = mk_model(cfg)

@@ -111,3 +111,72 @@ def test_non_divisible_cache_len_picks_divisor_chunk():
     out = decode_attend_pallas(q, k, v, lengths, chunk=256, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Carry-path kernels: layer-indexed attend + in-place row write
+# ---------------------------------------------------------------------------
+
+
+def _full_cache(L=3, B=4, S=64, Hkv=2, D=32, seed=3, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 2)
+    ck = jax.random.normal(ks[0], (L, B, Hkv, S, D), dtype)
+    cv = jax.random.normal(ks[1], (L, B, Hkv, S, D), dtype)
+    return ck, cv
+
+
+@pytest.mark.parametrize("layer", [0, 1, 2])
+def test_layer_indexed_attend_matches_sliced_reference(layer):
+    from aws_k8s_ansible_provisioner_tpu.ops.pallas_attention import (
+        decode_attend_pallas_layer,
+    )
+
+    ck, cv = _full_cache()
+    q, _, _, lengths = _inputs(B=4, S=64, Hq=4, Hkv=2, D=32)
+    ref = decode_attend(q, ck[layer], cv[layer], lengths)
+    out = decode_attend_pallas_layer(q, ck, cv, lengths, jnp.int32(layer),
+                                     chunk=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("rows", [[0, 7, 8, 9], [15, 16, 63, 1]])
+def test_cache_write_row_matches_scatter(rows):
+    """The aliased write kernel must land each slot's row exactly where the
+    functional scatter would, including rows on 8-row block boundaries."""
+    from aws_k8s_ansible_provisioner_tpu.ops.pallas_attention import (
+        cache_write_row,
+    )
+    from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
+
+    L, B, S, Hkv, D = 3, 4, 64, 2, 32
+    ck, cv = _full_cache(L=L, B=B, S=S, Hkv=Hkv, D=D)
+    lengths = jnp.asarray(rows, jnp.int32)
+    knew = jax.random.normal(jax.random.PRNGKey(9), (B, 1, Hkv, D))
+    layer = jnp.int32(1)
+
+    want = kvc.write_token_layer({"k": ck, "v": cv}, layer, lengths,
+                                 knew, knew)
+    got_k = cache_write_row(ck, knew[:, 0], lengths, layer, interpret=True)
+    got_v = cache_write_row(cv, knew[:, 0], lengths, layer, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want["k"]))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want["v"]))
+
+
+def test_cache_write_row_clamps_full_slot():
+    """A slot at lengths == S must clamp to the last row, not wrap or crash
+    (matches the scatter's drop semantics closely enough for the engine,
+    which never decodes a full slot)."""
+    from aws_k8s_ansible_provisioner_tpu.ops.pallas_attention import (
+        cache_write_row,
+    )
+
+    L, B, S, Hkv, D = 2, 2, 16, 2, 32
+    ck, _ = _full_cache(L=L, B=B, S=S, Hkv=Hkv, D=D)
+    lengths = jnp.asarray([S, 3], jnp.int32)
+    knew = jax.random.normal(jax.random.PRNGKey(4), (B, Hkv, D))
+    out = cache_write_row(ck, knew, lengths, jnp.int32(0), interpret=True)
+    np.testing.assert_allclose(np.asarray(out[0, 0, :, S - 1]),
+                               np.asarray(knew[0]))
+    np.testing.assert_allclose(np.asarray(out[0, 1, :, 3]),
+                               np.asarray(knew[1]))
