@@ -444,6 +444,19 @@ class ServingConfig:
     # in-flight streams keep making progress during a long prefill (the vLLM
     # behavior inside the reference's serving pods). 0 disables chunking.
     prefill_chunk: int = 0
+    # Automatic prefix caching (the vLLM feature of the same name): a new
+    # prompt sharing >= prefix_cache_min_len leading tokens with K/V rows
+    # still resident in another slot reuses them via one slot-to-slot row
+    # copy; only the suffix is prefilled (through the chunk program).
+    prefix_cache: bool = True
+    prefix_cache_min_len: int = 32
+    # A hit that ADDS dispatches vs the whole-prompt path (copy + suffix
+    # chunks > one bucket dispatch) must reuse at least this many rows: each
+    # extra dispatch is ~an RTT of latency, so small reuses only pay once
+    # the recomputed-prefill FLOPs they save outweigh it. Hits that don't
+    # add dispatches (same-slot reuse, would-chunk-anyway prompts) are
+    # always taken. See Engine._hit_pays.
+    prefix_cache_payback_rows: int = 256
     max_tokens_default: int = 256
     dtype: str = "bfloat16"
     # Attention backend: "xla" (fused SDPA fallback) or "pallas" (custom kernel).
@@ -523,6 +536,11 @@ def ansible_vars(cfg: FrameworkConfig | None = None) -> str:
     # a single source, unlike the reference's duplicated literals (SURVEY.md §1).
     d["model"] = cfg.serving.model
     d["serving_port"] = cfg.serving.port
+    # Serving mesh (chips per engine pod = tp * dp * sp; serving.yaml.j2
+    # passes these to the engine CLI and sizes the google.com/tpu limit).
+    d["serving_tp"] = cfg.serving.mesh.tp
+    d["serving_dp"] = cfg.serving.mesh.dp
+    d["serving_sp"] = cfg.serving.mesh.sp
     lines = ["# generated by aws_k8s_ansible_provisioner_tpu.config — do not edit"]
     for k, v in d.items():
         lines.append(f"{k}: {json.dumps(v)}")

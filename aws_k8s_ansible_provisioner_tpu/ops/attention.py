@@ -74,12 +74,19 @@ def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
     fallback pays one layer-slice copy per layer (fine on CPU, where the
     tests run it; on TPU the Pallas path is the point).
 
-    Sharding: slots over ``dp``, kv heads over ``tp``, zero collectives —
-    decode attention is (slot, head)-local, so shard_map runs the kernel on
-    each device's own cache shard (XLA can't partition a custom call on its
-    own; without shard_map it would force an all-gather of the cache).
+    Sharding: slots over ``dp``, kv heads over ``tp``, and the cache's
+    sequence axis over ``sp`` — shard_map runs the kernel on each device's
+    own cache shard (XLA can't partition a custom call on its own; without
+    shard_map it would force an all-gather of the cache). dp/tp decode needs
+    ZERO collectives. With ``sp > 1`` (long-context serving: the cache window
+    scales with the sp group's aggregate HBM) each shard computes flash
+    PARTIALS over its rows and the context is a log-sum-exp merge — one
+    [B,Hq,D]-sized psum per layer over ICI neighbors, the decode-side
+    equivalent of the training path's ring attention
+    (parallel/ring_attention.py).
     """
     resolved = resolve_impl(impl)
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
 
     def _write_attend(q, ck, cv, knew, vnew, lens, layer):
         """Per-shard body: in-place row writes + layer-indexed flash attend.
@@ -92,13 +99,37 @@ def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
         from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
 
         interpret = jax.default_backend() != "tpu"
-        ck = pallas_attention.cache_write_row(ck, knew, lens, layer,
+        S_local = ck.shape[3]
+        if sp > 1:
+            # This shard owns global rows [off, off + S_local). Writes use
+            # local row indices (non-owners fall out of [0, S) and DROP);
+            # reads mask by the local portion of each slot's length.
+            off = jax.lax.axis_index("sp").astype(jnp.int32) * S_local
+            w_rows = lens - off
+            r_lens = jnp.clip(lens + 1 - off, 0, S_local)
+        else:
+            w_rows = lens
+            r_lens = lens + 1
+        ck = pallas_attention.cache_write_row(ck, knew, w_rows, layer,
                                               interpret=interpret)
-        cv = pallas_attention.cache_write_row(cv, vnew, lens, layer,
+        cv = pallas_attention.cache_write_row(cv, vnew, w_rows, layer,
                                               interpret=interpret)
-        ctx = pallas_attention.decode_attend_pallas_layer(
-            q, ck, cv, lens + 1, layer, interpret=interpret)
-        return ctx, ck, cv
+        if sp == 1:
+            ctx = pallas_attention.decode_attend_pallas_layer(
+                q, ck, cv, r_lens, layer, interpret=interpret)
+            return ctx, ck, cv
+        acc, m, l = pallas_attention.decode_attend_pallas_layer(
+            q, ck, cv, r_lens, layer, interpret=interpret, return_stats=True)
+        # Merge partial softmaxes across sequence shards. A shard with none
+        # of a slot's rows carries (acc=0, m=-inf, l=0); the -inf-safe
+        # weights zero it out of the combine.
+        m_glob = jax.lax.pmax(m, "sp")                        # [B, Hq]
+        m_safe = jnp.where(m_glob <= -1e29, 0.0, m_glob)
+        w = jnp.where(m <= -1e29, 0.0, jnp.exp(m - m_safe))
+        l_glob = jax.lax.psum(l * w, "sp")
+        acc_glob = jax.lax.psum(acc * w[..., None], "sp")
+        ctx = acc_glob / jnp.maximum(l_glob, 1e-9)[..., None]
+        return ctx[:, None].astype(q.dtype), ck, cv
 
     def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, tuple]:
         cache, layer = cache_l
@@ -111,15 +142,15 @@ def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
                 fn = shard_map(
                     _write_attend, mesh=mesh,
                     in_specs=(P("dp", None, "tp", None),         # q [B,1,Hq,D]
-                              P(None, "dp", "tp", None, None),   # k [L,B,Hkv,S,D]
-                              P(None, "dp", "tp", None, None),   # v
+                              P(None, "dp", "tp", "sp", None),   # k [L,B,Hkv,S,D]
+                              P(None, "dp", "tp", "sp", None),   # v
                               P("dp", "tp", None),               # knew [B,Hkv,D]
                               P("dp", "tp", None),               # vnew
                               P("dp"),                           # lengths [B]
                               P()),                              # layer scalar
                     out_specs=(P("dp", None, "tp", None),
-                               P(None, "dp", "tp", None, None),
-                               P(None, "dp", "tp", None, None)),
+                               P(None, "dp", "tp", "sp", None),
+                               P(None, "dp", "tp", "sp", None)),
                     check_rep=False,
                 )
                 ctx, ck, cv = fn(q, cache["k"], cache["v"], knew, vnew,

@@ -107,11 +107,36 @@ def _decode_kernel_layer(lengths_ref,      # scalar prefetch [B] int32
         o_ref[0, :, :] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk", "interpret"))
+def _decode_kernel_layer_stats(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
+                               o_ref,       # [1, Hq, D] f32 UNNORMALIZED acc
+                               mo_ref,      # [1, Hq, 128] f32 running max
+                               lo_ref,      # [1, Hq, 128] f32 running denom
+                               acc_ref, m_ref, l_ref,
+                               *, chunk: int, groups: int, scale: float):
+    """Stats-emitting variant for sequence-parallel decode: instead of the
+    normalized context, outputs the raw flash triple (acc, m, l) so the
+    caller can merge partials across sequence shards with a log-sum-exp
+    combine (ops/attention.py sp path). A shard holding none of a slot's rows
+    emits (0, -inf, 0), which contributes nothing to the merge."""
+    _decode_kernel_layer(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
+                         o_ref, acc_ref, m_ref, l_ref,
+                         chunk=chunk, groups=groups, scale=scale)
+    c = pl.program_id(1)
+
+    @pl.when(c == pl.num_programs(1) - 1)
+    def _emit_stats():
+        o_ref[0, :, :] = acc_ref[:].astype(o_ref.dtype)  # overwrite normalized
+        mo_ref[0] = jnp.broadcast_to(m_ref[:, :1], mo_ref.shape[1:])
+        lo_ref[0] = jnp.broadcast_to(l_ref[:, :1], lo_ref.shape[1:])
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("chunk", "interpret", "return_stats"))
 def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
                                cache_v: jnp.ndarray, lengths: jnp.ndarray,
                                layer: jnp.ndarray, chunk: int = 256,
-                               interpret: bool = False) -> jnp.ndarray:
+                               interpret: bool = False,
+                               return_stats: bool = False):
     """Flash decode attention over ONE layer of the full stacked cache.
 
     q: [B, 1, Hq, D]; cache_k/v: [L, B, Hkv, S, D] (the whole cache buffer —
@@ -140,20 +165,49 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
         live = jnp.maximum(pl.cdiv(lens[b], chunk) - 1, 0)
         return (lay[0], b, 0, jnp.minimum(c, live), 0)
 
+    scratch = [
+        pltpu.VMEM((Hq, D), jnp.float32),
+        pltpu.VMEM((Hq, 128), jnp.float32),
+        pltpu.VMEM((Hq, 128), jnp.float32),
+    ]
+    in_specs = [
+        pl.BlockSpec((1, Hq, D), q_map),
+        pl.BlockSpec((1, 1, Hkv, chunk, D), kv_map),
+        pl.BlockSpec((1, 1, Hkv, chunk, D), kv_map),
+    ]
+    if return_stats:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, num_chunks),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, Hq, D), q_map),
+                pl.BlockSpec((1, Hq, 128), q_map),
+                pl.BlockSpec((1, Hq, 128), q_map),
+            ],
+            scratch_shapes=scratch,
+        )
+        kernel = functools.partial(
+            _decode_kernel_layer_stats, chunk=chunk, groups=groups,
+            scale=1.0 / (D ** 0.5))
+        acc, m, l = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((B, Hq, D), jnp.float32),
+                jax.ShapeDtypeStruct((B, Hq, 128), jnp.float32),
+                jax.ShapeDtypeStruct((B, Hq, 128), jnp.float32),
+            ],
+            interpret=interpret,
+        )(lengths, layer_arr, q[:, 0], cache_k, cache_v)
+        # stats are replicated along the 128-lane axis; take lane 0
+        return acc, m[:, :, 0], l[:, :, 0]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, num_chunks),
-        in_specs=[
-            pl.BlockSpec((1, Hq, D), q_map),
-            pl.BlockSpec((1, 1, Hkv, chunk, D), kv_map),
-            pl.BlockSpec((1, 1, Hkv, chunk, D), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Hq, D), q_map),
-        scratch_shapes=[
-            pltpu.VMEM((Hq, D), jnp.float32),
-            pltpu.VMEM((Hq, 128), jnp.float32),
-            pltpu.VMEM((Hq, 128), jnp.float32),
-        ],
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(
         _decode_kernel_layer, chunk=chunk, groups=groups,
@@ -174,7 +228,10 @@ def cache_write_row(cache: jnp.ndarray, new: jnp.ndarray,
     """Write one new K (or V) row per slot into the full cache, IN PLACE.
 
     cache: [L, B, Hkv, S, D]; new: [B, Hkv, D]; lengths: [B] (row index per
-    slot); layer: scalar int32. Returns the updated cache — same buffer.
+    slot — rows outside [0, S) are DROPPED, which both makes surplus
+    mid-horizon writes safe and lets sequence-parallel shards pass
+    ``global_row - shard_offset`` and have exactly the owning shard write);
+    layer: scalar int32. Returns the updated cache — same buffer.
 
     Why a kernel for a 2 KB-per-slot write: the functional alternatives all
     copy. ``.at[layer, rows, :, lengths].set(...)`` lowers to scatter, and
@@ -199,9 +256,11 @@ def cache_write_row(cache: jnp.ndarray, new: jnp.ndarray,
         return (b, 0, 0)
 
     def blk_map(b, lens, lay):
-        # S-axis block size ROWS -> block index = row // ROWS. Clamp
-        # defensively (engine budget keeps lengths < S already).
-        return (lay[0], b, 0, jnp.minimum(lens[b], S - 1) // ROWS, 0)
+        # S-axis block size ROWS -> block index = row // ROWS. Out-of-window
+        # rows (negative under sequence sharding, or >= S) clamp to a valid
+        # block here and are DROPPED by the kernel's row mask — the scatter
+        # mode='drop' contract.
+        return (lay[0], b, 0, jnp.clip(lens[b], 0, S - 1) // ROWS, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -215,7 +274,9 @@ def cache_write_row(cache: jnp.ndarray, new: jnp.ndarray,
 
     def kernel(lengths_ref, layer_ref, new_ref, cin_ref, cout_ref):
         b = pl.program_id(0)
-        r = jnp.minimum(lengths_ref[b], S - 1) % ROWS
+        tgt = lengths_ref[b]
+        in_window = (tgt >= 0) & (tgt < S)
+        r = jnp.where(in_window, jnp.clip(tgt, 0, S - 1) % ROWS, -1)
         row = jax.lax.broadcasted_iota(jnp.int32, (Hkv, ROWS, D), 1)
         cout_ref[0, 0] = jnp.where(row == r, new_ref[0][:, None, :],
                                    cin_ref[0, 0])

@@ -101,10 +101,13 @@ def param_pspecs(cfg: ModelConfig) -> dict:
 
 
 def cache_pspecs() -> dict:
-    """Decode cache [L, slots, Hkv, S, D]: slots over dp, kv heads over tp."""
+    """Decode cache [L, slots, Hkv, S, D]: slots over dp, kv heads over tp,
+    sequence over sp (no-op on meshes with a size-1 sp axis; with sp > 1 the
+    cache window scales with the sp group's aggregate HBM — the long-context
+    serving axis)."""
     return {
-        "k": P(None, "dp", "tp", None, None),
-        "v": P(None, "dp", "tp", None, None),
+        "k": P(None, "dp", "tp", "sp", None),
+        "v": P(None, "dp", "tp", "sp", None),
     }
 
 

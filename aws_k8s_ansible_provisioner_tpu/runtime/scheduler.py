@@ -179,6 +179,11 @@ class PyScheduler:
         self._slot_req = [-1] * num_slots
         self._slot_len = [0] * num_slots
         self._slot_cancelled = [False] * num_slots
+        # Least-recently-released free slots (admit from front, release to
+        # back): a freed slot is reused LAST, maximizing how long its K/V
+        # rows stay available to the engine's prefix cache. Mirrors the
+        # native core's free_slots deque.
+        self._free: collections.deque = collections.deque(range(num_slots))
         self._admitted = 0
         self._finished = 0
         self._cancelled = 0
@@ -203,8 +208,7 @@ class PyScheduler:
 
     def pop_admission(self) -> Optional[Tuple]:
         with self._lock:
-            free = next((s for s, r in enumerate(self._slot_req) if r < 0),
-                        None)
+            free = self._free[0] if self._free else None
             while self._queue:
                 rid, plen, mtok = self._queue[0]
                 if rid in self._cancelled_pending:
@@ -215,6 +219,7 @@ class PyScheduler:
                 if free is None:
                     return None
                 self._queue.popleft()
+                self._free.popleft()
                 self._slot_req[free] = rid
                 self._slot_len[free] = 0
                 self._slot_cancelled[free] = False
@@ -247,6 +252,7 @@ class PyScheduler:
             rid = self._slot_req[slot]
             self._slot_req[slot] = -1
             self._slot_len[slot] = 0
+            self._free.append(slot)
             if self._slot_cancelled[slot]:
                 self._cancelled += 1
             else:

@@ -188,10 +188,8 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
     scheduler only uses a horizon > 1 when no prefill is waiting, so TTFT is
     not taxed. Slots that hit a stop condition mid-horizon generate a few
     surplus tokens which the host discards; surplus K/V writes past
-    ``max_len`` CLAMP onto the slot's last row (cache_write_row's block-index
-    clamp) — harmless garbage, because the row is masked by the slot's length
-    until the moment a later decode step writes that row itself, immediately
-    before the first attend that could read it.
+    ``max_len`` are dropped (cache_write_row masks rows outside [0, S); the
+    XLA fallback's scatter drops them natively) — never corrupt memory.
     """
 
     def body(carry, rng_i):
@@ -262,10 +260,15 @@ class Engine:
 
             tp = self.mesh.shape["tp"]
             dp = self.mesh.shape["dp"]
+            sp = self.mesh.shape.get("sp", 1)
             check_tp_divisibility(cfg, tp)
             if self.num_slots % dp:
                 raise ValueError(f"max_decode_slots={self.num_slots} must be "
                                  f"divisible by dp={dp}")
+            if sp > 1 and self.max_len % (sp * 8):
+                raise ValueError(
+                    f"cache window {self.max_len} must split into 8-row-"
+                    f"aligned sequence shards; not divisible by sp={sp} * 8")
             self.params = params = shard_params(params, self.mesh, cfg)
         if self.mesh is not None:
             # Allocate the cache DIRECTLY sharded (jit with out_shardings):
@@ -308,22 +311,30 @@ class Engine:
         self._lock = threading.Lock()
         self._work_event = threading.Event()
         self._tok_times: Deque = collections.deque(maxlen=50)
-        # Chunked-prefill state: {"req", "slot", "off"} while a long prompt is
-        # being prefilled chunk-by-chunk; decode steps interleave between
-        # chunks (self._chunk_yield alternates).
+        # Chunked-prefill state: {"req", "slot", "off", "C"} while a prompt
+        # (or a prefix-cache suffix) is being prefilled chunk-by-chunk; decode
+        # steps interleave between chunks (self._chunk_yield alternates).
         self._chunk: Optional[dict] = None
         self._chunk_yield = False
+        # Prefix cache: token ids whose K/V rows are resident in rows
+        # [0, len) of each slot — retained after a request finishes (rows are
+        # only ever written at/past a slot's current length, so a freed
+        # slot's prompt rows stay intact until the slot is reused).
+        self._slot_tokens: List[tuple] = [()] * self.num_slots
 
     @staticmethod
     def _build_mesh(serving: ServingConfig):
-        """Build the serving mesh from config (None for single-device)."""
+        """Build the serving mesh from config (None for single-device).
+
+        All three axes serve: ``dp`` shards slots, ``tp`` shards heads
+        (Megatron), ``sp`` shards the KV cache's sequence axis — the
+        long-context axis, letting the cache window scale with the sp group's
+        aggregate HBM (decode merges per-shard flash partials; see
+        ops/attention.make_decode_attend_carry).
+        """
         mc = serving.mesh
         if mc.num_devices <= 1:
             return None
-        if mc.sp != 1:
-            raise ValueError("serving mesh uses dp/tp only (sp is a training/"
-                             "long-context axis); got sp="
-                             f"{mc.sp}")
         from aws_k8s_ansible_provisioner_tpu.parallel.mesh import make_mesh
 
         return make_mesh(mc)
@@ -357,6 +368,64 @@ class Engine:
         # prompt in (buckets[-1], prefill_chunk] must take the chunked path
         # too — the whole-prompt path cannot represent it (review r2 #2).
         return n > self.serving.prefill_chunk or n > self.buckets[-1]
+
+    @property
+    def _chunk_size(self) -> int:
+        """Chunk program width: the configured chunk, else the largest bucket
+        (the prefix-cache suffix path needs a chunk program even when plain
+        chunked prefill is disabled)."""
+        if self.serving.prefill_chunk > 0:
+            return self.serving.prefill_chunk
+        return self.buckets[-1]
+
+    def _find_prefix(self, req: Request, slot: int):
+        """Longest resident prompt prefix for ``req`` → (src_slot, n) or None.
+
+        Scans the per-slot retained prompt tokens (host-side; <= num_slots
+        short tuple comparisons). The reuse is capped one token short of the
+        prompt — the final token must run through prefill to produce the
+        request's first sampled token. ``slot`` is the slot just assigned to
+        the request (for the dispatch-economics gate; matching it means the
+        rows are already in place and reuse is free).
+        """
+        if not self.serving.prefix_cache:
+            return None
+        ids = req.prompt_ids
+        cap = len(ids) - 1
+        best_n, best_s = 0, -1
+        for s, toks in enumerate(self._slot_tokens):
+            m = min(len(toks), cap)
+            if m <= best_n:
+                continue
+            n = 0
+            while n < m and toks[n] == ids[n]:
+                n += 1
+            if n > best_n:
+                best_n, best_s = n, s
+        if best_n < max(1, self.serving.prefix_cache_min_len):
+            return None
+        if not self._hit_pays(req, best_s, slot, best_n):
+            return None
+        return best_s, best_n
+
+    def _hit_pays(self, req: Request, src: int, slot: int, n: int) -> bool:
+        """Dispatch-economics gate on a prefix hit.
+
+        The hit path costs one slot-copy dispatch (zero when the request got
+        its own previous slot back) plus ceil(suffix/C) chunk dispatches; the
+        miss path costs one bucket dispatch (or ceil(len/C) chunks for a
+        prompt that chunks anyway). Each dispatch is ~an RTT on a
+        network-attached chip, so a hit that ADDS dispatches only pays once
+        the reused rows save enough prefill FLOPs to beat the added latency —
+        ``prefix_cache_payback_rows`` calibrates that crossover (lower it for
+        big models, where recompute dominates sooner)."""
+        C = self._chunk_size
+        ln = len(req.prompt_ids)
+        hit_disp = (0 if src == slot else 1) + max(1, -(-(ln - n) // C))
+        miss_disp = -(-ln // C) if self._should_chunk(req) else 1
+        if hit_disp <= miss_disp:
+            return True
+        return n >= max(1, self.serving.prefix_cache_payback_rows)
 
     def submit(self, req: Request) -> Request:
         req.t_submit = time.monotonic()
@@ -456,8 +525,27 @@ class Engine:
             if req is None:  # should not happen; free the slot defensively
                 self.sched.release(slot)
                 continue
-            if self._should_chunk(req):
-                chunk_next = (req, slot)
+            # Prefix reuse goes through the (serialized) chunk program, so
+            # only consult the cache for an ISOLATED arrival — empty batch
+            # and nothing else waiting. Under a burst, batched prefill wins:
+            # taking the chunk path per request would serialize the whole
+            # burst into one ~RTT dispatch each, costing far more than the
+            # prefix recompute it saves at bucket sizes (the isolated case —
+            # a follow-up chat turn re-sending its history — is where the
+            # rows are long and reuse pays). The consult happens BEFORE this
+            # slot's retained tokens are cleared so the request may match its
+            # own just-freed slot (the saturated-engine follow-up-turn case:
+            # rows already in place, reuse is free).
+            pref = None
+            if not batch and self.sched.stats().queue_depth == 0:
+                pref = self._find_prefix(req, slot)
+            # The slot just assigned will be overwritten by this admission
+            # round's prefill — its retained rows must stop matching as a
+            # prefix source from here on, or a later request in this same
+            # loop could copy rows the batch prefill is about to clobber.
+            self._slot_tokens[slot] = ()
+            if self._should_chunk(req) or pref is not None:
+                chunk_next = (req, slot, pref)
                 break
             batch.append((req, slot))
         if batch:
@@ -477,20 +565,18 @@ class Engine:
                     self.metrics.mark_request("error", 0.0)
                     req.out_queue.put(None)
                 if chunk_next is not None:
-                    req, slot = chunk_next
+                    req, slot, _ = chunk_next
                     self.sched.release(slot)
                     req.finish_reason = "error"
                     self.metrics.mark_request("error", 0.0)
                     req.out_queue.put(None)
                 raise
             if chunk_next is not None:  # chunking starts next step
-                self._chunk = {"req": chunk_next[0], "slot": chunk_next[1],
-                               "off": 0}
+                self._start_chunk(*chunk_next)
                 self._chunk_yield = False
             return True
         if chunk_next is not None:
-            self._chunk = {"req": chunk_next[0], "slot": chunk_next[1],
-                           "off": 0}
+            self._start_chunk(*chunk_next)
             self._advance_chunk()
             self._chunk_yield = True
             return True
@@ -505,6 +591,7 @@ class Engine:
         req.t_first_token = now
         self.metrics.ttft.observe(now - req.t_submit)
         self.metrics.prompt_tokens.inc(len(req.prompt_ids))
+        self._slot_tokens[slot] = tuple(req.prompt_ids)
         self.slot_req[slot] = req
         self.lengths[slot] = len(req.prompt_ids)
         self.temps[slot] = req.temperature
@@ -515,6 +602,7 @@ class Engine:
         self._emit(slot, token)
 
     def _do_prefill(self, req: Request, slot: int):
+        self._slot_tokens[slot] = ()   # rows about to be overwritten
         ids = req.prompt_ids
         bucket = self._bucket_for(len(ids))
         tokens = np.zeros((1, bucket), np.int32)
@@ -544,6 +632,7 @@ class Engine:
         top_ks = np.zeros(n_bucket, np.int32)
         top_ps = np.ones(n_bucket, np.float32)
         for i, (req, slot) in enumerate(batch):
+            self._slot_tokens[slot] = ()   # rows about to be overwritten
             ids = req.prompt_ids
             tokens[i, :len(ids)] = ids
             true_lens[i] = len(ids)
@@ -561,6 +650,29 @@ class Engine:
         for i, (req, slot) in enumerate(batch):
             self._activate(req, slot, int(toks[i]))
 
+    def _start_chunk(self, req: Request, slot: int, pref):
+        """Begin chunked prefill of ``req`` into ``slot``; with a prefix-cache
+        hit (``pref = (src_slot, n)``), first copy the n resident rows from
+        the source slot and start the chunk walk at the suffix."""
+        self._slot_tokens[slot] = ()   # rows about to be overwritten
+        off = 0
+        if pref is not None:
+            src, n = pref
+            if src != slot:   # reusing the same slot: rows already in place
+                t0 = time.monotonic()
+                self.cache = kvc.copy_prefix(self.cache, src, slot, n)
+                # sync before reading the clock: the copy is async, and an
+                # unsynced window would record ~0 busy time for the device
+                # work this feature adds
+                jax.block_until_ready(self.cache["k"])
+                self.metrics.device_busy_seconds.inc(time.monotonic() - t0)
+            off = n
+            self.metrics.prefix_cache_hits.inc()
+            self.metrics.prefix_tokens_reused.inc(n)
+        self.lengths[slot] = off
+        self._chunk = {"req": req, "slot": slot, "off": off,
+                       "C": self._chunk_size}
+
     def _advance_chunk(self):
         """Dispatch the next chunk of the in-progress chunked prefill."""
         st = self._chunk
@@ -573,7 +685,7 @@ class Engine:
                                       time.monotonic() - req.t_submit)
             req.out_queue.put(None)
             return
-        C = self.serving.prefill_chunk
+        C = st["C"]
         ids = req.prompt_ids
         off = st["off"]
         chunk = ids[off:off + C]
@@ -665,7 +777,12 @@ class Engine:
                   else req.finish_reason or "success")
         self.metrics.mark_request(status, req.t_done - req.t_submit)
         self.slot_req[slot] = None
-        self.lengths[slot] = 0
+        # Keep the freed slot's length: decode dispatches write a scratch K/V
+        # row for EVERY slot at its current length, so a zeroed length would
+        # let that garbage land on row 0 — corrupting the retained prompt
+        # rows the prefix cache reuses. At >= final length, scratch writes
+        # stay past the prompt (generation length >= 1 guarantees
+        # final length >= prompt length).
         self.temps[slot] = 0.0
         self.sched.release(slot)
         self.metrics.active_requests.set(len(self._active_slots()))
@@ -743,8 +860,11 @@ class Engine:
                    or self._chunk is not None):
                 self.step()
 
-        for b in self.buckets:
-            r = Request(prompt_ids=[0] * min(b, self.max_len - 2),
+        # Distinct token values per warmup request — identical prompts would
+        # prefix-cache-match each other and warm the WRONG program.
+        for i, b in enumerate(self.buckets):
+            r = Request(prompt_ids=[(2 * i + 1) % (self.cfg.vocab_size - 1)]
+                        * min(b, self.max_len - 2),
                         max_tokens=1, ignore_eos=True)
             self.submit(r)
             drain()
@@ -761,9 +881,26 @@ class Engine:
         # Chunk-prefill program (one program serves every chunk).
         if self.serving.prefill_chunk > 0 \
                 and self.max_len - 2 > self.serving.prefill_chunk:
-            r = Request(prompt_ids=[0] * (self.serving.prefill_chunk + 1),
+            r = Request(prompt_ids=[97 % (self.cfg.vocab_size - 1)]
+                        * (self.serving.prefill_chunk + 1),
                         max_tokens=1, ignore_eos=True)
             self.submit(r)
+            drain()
+        # Prefix-cache programs (slot-to-slot copy + suffix chunk): a seed
+        # prompt, then an extension of it, so the second takes the hit path.
+        # The seed must clear BOTH gates (min_len and payback rows); when
+        # that doesn't fit the prompt limit, the programs compile lazily on
+        # the first real hit instead.
+        n_seed = max(1, self.serving.prefix_cache_min_len,
+                     self.serving.prefix_cache_payback_rows) + 1
+        if self.serving.prefix_cache and n_seed + 8 <= self.prompt_limit:
+            tok = 43 % (self.cfg.vocab_size - 1)
+            seed = [tok] * n_seed
+            self.submit(Request(prompt_ids=list(seed), max_tokens=1,
+                                ignore_eos=True))
+            drain()
+            self.submit(Request(prompt_ids=list(seed) + [tok + 1] * 8,
+                                max_tokens=1, ignore_eos=True))
             drain()
         # compile the fused decode program too (horizon path)
         horizon = max(1, self.serving.decode_horizon)

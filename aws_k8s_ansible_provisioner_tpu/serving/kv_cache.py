@@ -25,6 +25,7 @@ Raggedness (every slot at a different sequence length) is expressed by a
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
@@ -146,6 +147,41 @@ def write_token_layer(cache: dict, layer: jnp.ndarray, lengths: jnp.ndarray,
         "k": cache["k"].at[layer, rows, :, lengths].set(k[:, 0]),
         "v": cache["v"].at[layer, rows, :, lengths].set(v[:, 0]),
     }
+
+
+# Donating the cache is what makes this a ~rows-sized copy: the engine
+# rebinds self.cache to the result immediately, so the input buffer is dead
+# and XLA updates it in place. Without donation every prefix hit would
+# materialize a second full cache (14+ GB transient at the bench config).
+@functools.partial(jax.jit, donate_argnums=0)
+def _copy_prefix(cache: dict, src: jnp.ndarray, dst: jnp.ndarray,
+                 n_rows: jnp.ndarray) -> dict:
+    def one(arr):
+        S = arr.shape[3]
+        src_s = jax.lax.dynamic_index_in_dim(arr, src, axis=1)   # [L,1,H,S,D]
+        dst_s = jax.lax.dynamic_index_in_dim(arr, dst, axis=1)
+        keep = jnp.arange(S)[None, None, None, :, None] < n_rows
+        mixed = jnp.where(keep, src_s, dst_s)
+        return jax.lax.dynamic_update_slice_in_dim(arr, mixed, dst, axis=1)
+
+    return {"k": one(cache["k"]), "v": one(cache["v"])}
+
+
+def copy_prefix(cache: dict, src_slot: int, dst_slot: int, n_rows: int) -> dict:
+    """Copy rows [0, n_rows) of ``src_slot`` into ``dst_slot``, all layers.
+
+    The engine's automatic prefix caching (serving/engine.py): a new request
+    whose prompt shares a prefix with tokens still resident in another slot
+    reuses those K/V rows instead of recomputing them — the TPU analogue of
+    vLLM's prefix caching, expressed as one masked slot-to-slot copy (the
+    slot-contiguous layout makes the prefix a contiguous row range; for a
+    512-token prefix of Qwen3-0.6B this moves ~60 MB, vs recomputing 512
+    tokens x 28 layers of prefill FLOPs). Under a dp-sharded mesh GSPMD
+    inserts the cross-shard collective when src and dst live on different
+    data-parallel groups.
+    """
+    return _copy_prefix(cache, jnp.int32(src_slot), jnp.int32(dst_slot),
+                        jnp.int32(n_rows))
 
 
 def pages_view(cache: dict, page_size: int) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -163,6 +163,12 @@ class EngineMetrics:
         self.device_busy_seconds = r.register(Counter(
             "tpu_serve_device_busy_seconds_total",
             "Seconds spent in device dispatches (duty-cycle source)"))
+        self.prefix_cache_hits = r.register(Counter(
+            "tpu_serve_prefix_cache_hits_total",
+            "Requests that reused a cached prompt prefix"))
+        self.prefix_tokens_reused = r.register(Counter(
+            "tpu_serve_prefix_tokens_reused_total",
+            "Prompt tokens served from the prefix cache instead of prefill"))
 
     def mark_request(self, status: str, duration_s: float):
         self.request_total.inc(status=status)

@@ -481,6 +481,15 @@ def main(argv=None):
                         "ICI mesh; needs tp devices)")
     p.add_argument("--dp", type=int, default=1,
                    help="data-parallel degree (shards decode slots)")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel degree (shards the KV cache's "
+                        "sequence axis — the long-context axis; decode "
+                        "merges per-shard flash partials over ICI)")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="chunked prefill size; 0 disables (long prompts "
+                        "then cap at the largest bucket)")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable automatic prompt-prefix K/V reuse")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
@@ -501,7 +510,9 @@ def main(argv=None):
         max_decode_slots=args.max_decode_slots,
         max_cache_len=args.max_cache_len, dtype=args.dtype,
         checkpoint_dir=args.checkpoint_dir, chat_template=args.chat_template,
-        mesh=MeshConfig(dp=args.dp, tp=args.tp))
+        prefill_chunk=args.prefill_chunk,
+        prefix_cache=not args.no_prefix_cache,
+        mesh=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp))
     state = build_state(serving)
     if not args.no_warmup:
         log.info("warmup: compiling %d prefill buckets + decode ...",

@@ -32,6 +32,12 @@ struct ts_runtime {
   std::vector<int32_t> slot_len;
   std::vector<uint8_t> slot_cancelled;
 
+  // Free slots in least-recently-released order. Admission pops the front,
+  // release pushes the back: a freed slot is reused LAST, which maximizes
+  // how long its K/V rows stay available to the engine's prefix cache
+  // (lowest-free-index allocation would recycle the most useful slot first).
+  std::deque<int32_t> free_slots;
+
   int64_t admitted_total = 0;
   int64_t finished_total = 0;
   int64_t cancelled_total = 0;
@@ -48,6 +54,7 @@ ts_runtime* ts_create(int32_t num_slots, int32_t max_len, int32_t page_size) {
   rt->slot_req.assign(num_slots, -1);
   rt->slot_len.assign(num_slots, 0);
   rt->slot_cancelled.assign(num_slots, 0);
+  for (int32_t s = 0; s < num_slots; ++s) rt->free_slots.push_back(s);
   return rt;
 }
 
@@ -82,10 +89,8 @@ int32_t ts_pop_admission(ts_runtime* rt, int64_t* req_id, int32_t* slot,
                          int64_t* cancelled_id, int32_t* n_cancelled) {
   std::lock_guard<std::mutex> lock(rt->mu);
   *n_cancelled = 0;
-  int32_t free_slot = -1;
-  for (int32_t s = 0; s < rt->num_slots; ++s) {
-    if (rt->slot_req[s] < 0) { free_slot = s; break; }
-  }
+  int32_t free_slot =
+      rt->free_slots.empty() ? -1 : rt->free_slots.front();
   while (!rt->queue.empty()) {
     Pending p = rt->queue.front();
     auto it = rt->cancelled_pending.find(p.req_id);
@@ -101,6 +106,7 @@ int32_t ts_pop_admission(ts_runtime* rt, int64_t* req_id, int32_t* slot,
     }
     if (free_slot < 0) return 0;  // queue non-empty but no capacity
     rt->queue.pop_front();
+    rt->free_slots.pop_front();
     rt->slot_req[free_slot] = p.req_id;
     rt->slot_len[free_slot] = 0;
     rt->slot_cancelled[free_slot] = 0;
@@ -131,6 +137,7 @@ int64_t ts_release(ts_runtime* rt, int32_t slot) {
   int64_t id = rt->slot_req[slot];
   rt->slot_req[slot] = -1;
   rt->slot_len[slot] = 0;
+  rt->free_slots.push_back(slot);
   if (rt->slot_cancelled[slot]) rt->cancelled_total += 1; else rt->finished_total += 1;
   rt->slot_cancelled[slot] = 0;
   return id;

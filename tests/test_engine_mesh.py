@@ -91,7 +91,7 @@ def test_mesh_cache_is_actually_sharded(setup):
     sharding = k.sharding
     assert isinstance(sharding, jax.sharding.NamedSharding)
     assert sharding.spec == jax.sharding.PartitionSpec(
-        None, "dp", "tp", None, None)
+        None, "dp", "tp", "sp", None)
     shard_shape = k.addressable_shards[0].data.shape
     assert shard_shape[1] == serving.max_decode_slots // 2   # slots / dp
     assert shard_shape[2] == cfg.num_kv_heads // 2           # heads / tp
@@ -143,3 +143,68 @@ def test_mesh_engine_continuous_batching_queueing(setup):
     meshed = Engine(cfg, params, serving, mesh=_mesh(2, 2))
     got = _run_all(meshed, prompts, max_tokens=5)
     assert got == expected
+
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel (sp) long-context serving: cache S-axis sharded
+# ---------------------------------------------------------------------------
+
+
+def _mesh3(dp, tp, sp):
+    return make_mesh(MeshConfig(dp=dp, tp=tp, sp=sp),
+                     devices=jax.devices("cpu"))
+
+
+@pytest.mark.parametrize("dp,tp,sp", [(1, 1, 2), (2, 1, 2), (1, 2, 2),
+                                      (1, 1, 4)])
+def test_mesh_engine_sp_token_parity(setup, dp, tp, sp):
+    """Sequence-parallel decode — cache sequence axis sharded over sp, flash
+    partials merged with a log-sum-exp psum — must be token-identical to the
+    single-device engine (the long-context serving axis; SURVEY.md §5
+    'Long-context / sequence parallelism': absent in the reference)."""
+    cfg, params, serving = setup
+    serving_p = dataclasses.replace(serving, attention_impl="pallas")
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(2, cfg.vocab_size, n).tolist() for n in (3, 9, 14)]
+
+    single = Engine(cfg, params, serving)
+    expected = _run_all(single, prompts)
+
+    meshed = Engine(cfg, params, serving_p, mesh=_mesh3(dp, tp, sp))
+    got = _run_all(meshed, prompts)
+    assert got == expected, f"dp={dp} tp={tp} sp={sp} diverged"
+
+
+def test_mesh_engine_sp_long_generation_crosses_shards(setup):
+    """Generate far past the first sequence shard's boundary so decode rows
+    land on shard 1 while attention spans both shards."""
+    cfg, params, serving = setup
+    serving_p = dataclasses.replace(serving, attention_impl="pallas")
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(2, cfg.vocab_size, 4).tolist()]
+
+    single = Engine(cfg, params, serving)
+    expected = _run_all(single, prompts, max_tokens=40)   # crosses 64/2 = 32
+
+    meshed = Engine(cfg, params, serving_p, mesh=_mesh3(1, 1, 2))
+    got = _run_all(meshed, prompts, max_tokens=40)
+    assert got == expected
+
+
+def test_mesh_sp_divisibility_error(setup):
+    cfg, params, serving = setup
+    bad = dataclasses.replace(serving, max_cache_len=40)  # 40 % (2*8) != 0
+    with pytest.raises(ValueError, match="sequence shards"):
+        Engine(cfg, params, bad, mesh=_mesh3(1, 1, 2))
+
+
+def test_mesh_sp1_allows_unaligned_cache(setup):
+    """The sp alignment guard must not fire for sp=1 meshes: a dp/tp-only
+    engine with a non-8-aligned cache window worked before the sp axis
+    existed and must keep working (code-review r2 finding #3)."""
+    cfg, params, serving = setup
+    odd = dataclasses.replace(serving, max_cache_len=60)   # 60 % 8 != 0
+    engine = Engine(cfg, params, odd, mesh=_mesh(2, 1))
+    prompts = [[5, 7, 11]]
+    single = Engine(cfg, params, odd)
+    assert _run_all(engine, prompts) == _run_all(single, prompts)

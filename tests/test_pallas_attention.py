@@ -163,20 +163,22 @@ def test_cache_write_row_matches_scatter(rows):
     np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want["v"]))
 
 
-def test_cache_write_row_clamps_full_slot():
-    """A slot at lengths == S must clamp to the last row, not wrap or crash
-    (matches the scatter's drop semantics closely enough for the engine,
-    which never decodes a full slot)."""
+def test_cache_write_row_drops_out_of_window_rows():
+    """Rows outside [0, S) are DROPPED — the scatter mode='drop' contract.
+    Surplus mid-horizon writes (row == S) and sequence-parallel non-owner
+    shards (negative local rows) both rely on it."""
     from aws_k8s_ansible_provisioner_tpu.ops.pallas_attention import (
         cache_write_row,
     )
 
-    L, B, S, Hkv, D = 2, 2, 16, 2, 32
+    L, B, S, Hkv, D = 2, 3, 16, 2, 32
     ck, _ = _full_cache(L=L, B=B, S=S, Hkv=Hkv, D=D)
-    lengths = jnp.asarray([S, 3], jnp.int32)
+    lengths = jnp.asarray([S, 3, -5], jnp.int32)
     knew = jax.random.normal(jax.random.PRNGKey(4), (B, Hkv, D))
     out = cache_write_row(ck, knew, lengths, jnp.int32(0), interpret=True)
-    np.testing.assert_allclose(np.asarray(out[0, 0, :, S - 1]),
-                               np.asarray(knew[0]))
-    np.testing.assert_allclose(np.asarray(out[0, 1, :, 3]),
+    np.testing.assert_array_equal(np.asarray(out[:, 0]),    # dropped (row S)
+                                  np.asarray(ck[:, 0]))
+    np.testing.assert_allclose(np.asarray(out[0, 1, :, 3]),  # written
                                np.asarray(knew[1]))
+    np.testing.assert_array_equal(np.asarray(out[:, 2]),    # dropped (neg)
+                                  np.asarray(ck[:, 2]))
