@@ -79,6 +79,22 @@ class ModelConfig:
     # ids — e.g. <|end_of_text|> plus <|eot_id|>; chat turns end with the
     # latter). Tuple, not list, so the config stays hashable for jit.
     extra_eos_token_ids: tuple = ()
+    # Mixture of Experts (Qwen3-MoE family): 0 experts = dense MLP. When
+    # num_experts > 0 every layer's MLP is a router + num_experts SwiGLU
+    # experts of width moe_intermediate_size, top-k per token
+    # (num_experts_per_tok), with router-weight renormalization over the
+    # top-k (norm_topk_prob — HF Qwen3MoeSparseMoeBlock semantics).
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: int = 0
+    norm_topk_prob: bool = True
+    # Expert-compute implementation (ops/moe.py): "ragged" = exact no-drop
+    # sorted grouped matmul (jax.lax.ragged_dot; the single-device serving
+    # path); "gshard" = fixed-capacity one-hot dispatch einsums — fully
+    # GSPMD-partitionable over the mesh's ep axis (the distributed path;
+    # tokens past an expert's capacity fall back to the residual stream).
+    moe_impl: str = "ragged"
+    moe_capacity_factor: float = 2.0
     hf_repo: str = ""
 
     @property
@@ -261,6 +277,28 @@ TINYLLAMA_1_1B = ModelConfig(
     hf_repo="TinyLlama/TinyLlama-1.1B-Chat-v1.0",
 )
 
+QWEN3_30B_A3B = ModelConfig(
+    name="Qwen/Qwen3-30B-A3B",
+    vocab_size=151936,
+    hidden_size=2048,
+    intermediate_size=6144,        # dense-MLP width (unused: all layers MoE)
+    num_layers=48,
+    num_heads=32,
+    num_kv_heads=4,
+    head_dim=128,
+    max_seq_len=40960,
+    rope_theta=1e6,
+    qk_norm=True,
+    tie_embeddings=False,
+    bos_token_id=151643,
+    eos_token_id=151645,
+    num_experts=128,
+    num_experts_per_tok=8,
+    moe_intermediate_size=768,
+    norm_topk_prob=True,
+    hf_repo="Qwen/Qwen3-30B-A3B",
+)
+
 MODEL_REGISTRY = {
     "Qwen/Qwen3-0.6B": QWEN3_0_6B,
     "Qwen/Qwen3-8B": QWEN3_8B,
@@ -297,6 +335,30 @@ def tiny_qwen3(**overrides) -> ModelConfig:
         qk_norm=True,
         tie_embeddings=True,
         eos_token_id=1,
+    )
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+def tiny_qwen3_moe(**overrides) -> ModelConfig:
+    """A miniature Qwen3-MoE-shaped config (router + SwiGLU experts, GQA)."""
+    base = dict(
+        name="tiny-qwen3-moe",
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_seq_len=128,
+        rope_theta=1e6,
+        qk_norm=True,
+        tie_embeddings=True,
+        eos_token_id=1,
+        num_experts=8,
+        num_experts_per_tok=2,
+        moe_intermediate_size=32,
     )
     base.update(overrides)
     return ModelConfig(**base)
@@ -388,8 +450,10 @@ class MeshConfig:
     """Logical device mesh (SURVEY.md §2.3: every parallelism capability is net-new).
 
     Axes: ``dp`` data-parallel replicas, ``tp`` tensor parallel over ICI, ``sp``
-    sequence/context parallel (ring attention). The product must equal the device
-    count. The communication backend is XLA collectives emitted by the compiler
+    sequence/context parallel (ring attention), ``ep`` expert parallel (MoE
+    expert weights sharded; GSPMD turns the gshard dispatch einsums into
+    all-to-all-style collectives). The product must equal the device count.
+    The communication backend is XLA collectives emitted by the compiler
     from these shardings — nothing to install (replaces the reference stack's
     implicit NCCL, SURVEY.md §5 "Distributed communication backend").
     """
@@ -397,14 +461,15 @@ class MeshConfig:
     dp: int = 1
     tp: int = 1
     sp: int = 1
+    ep: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.tp * self.sp
+        return self.dp * self.tp * self.sp * self.ep
 
     @property
     def axis_names(self):
-        return ("dp", "tp", "sp")
+        return ("dp", "sp", "ep", "tp")
 
 
 # ---------------------------------------------------------------------------
@@ -459,6 +524,13 @@ class ServingConfig:
     prefix_cache_payback_rows: int = 256
     max_tokens_default: int = 256
     dtype: str = "bfloat16"
+    # KV-cache storage dtype: "auto" follows ``dtype``; "int8" stores K/V rows
+    # quantized with per-(layer, slot, head, row) float32 scales — half the
+    # decode HBM streaming and half the cache footprint (so ~2x the slots fit
+    # beside the weights), at near-lossless attention accuracy. The vLLM
+    # engine inside the reference's serving pods ships the same knob as
+    # ``kv_cache_dtype``. See serving/kv_cache.py.
+    kv_dtype: str = "auto"
     # Attention backend: "xla" (fused SDPA fallback) or "pallas" (custom kernel).
     attention_impl: str = "auto"
     checkpoint_dir: str = ""

@@ -88,17 +88,22 @@ def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
     resolved = resolve_impl(impl)
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
 
-    def _write_attend(q, ck, cv, knew, vnew, lens, layer):
+    def _write_attend(q, cache, knew, vnew, lens, layer):
         """Per-shard body: in-place row writes + layer-indexed flash attend.
 
-        The writes use the aliased Pallas kernel — NOT a functional scatter —
-        so the multi-GB cache buffers are updated in place even inside the
-        decode scan's carry (XLA copy-insertion materializes full-cache copies
-        around scatters there; see cache_write_row's docstring).
+        ``cache`` is the leaf dict ({k, v} bf16, or {k, v, ks, vs} int8 —
+        the quantized cache streams half the bytes and the kernels fold the
+        scales in VMEM). The writes use the aliased Pallas kernels — NOT a
+        functional scatter — so the multi-GB cache buffers are updated in
+        place even inside the decode scan's carry (XLA copy-insertion
+        materializes full-cache copies around scatters there; see
+        cache_write_row's docstring).
         """
         from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
 
         interpret = jax.default_backend() != "tpu"
+        ck, cv = cache["k"], cache["v"]
+        quant = "ks" in cache
         S_local = ck.shape[3]
         if sp > 1:
             # This shard owns global rows [off, off + S_local). Writes use
@@ -110,16 +115,27 @@ def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
         else:
             w_rows = lens
             r_lens = lens + 1
-        ck = pallas_attention.cache_write_row(ck, knew, w_rows, layer,
-                                              interpret=interpret)
-        cv = pallas_attention.cache_write_row(cv, vnew, w_rows, layer,
-                                              interpret=interpret)
+        if quant:
+            ck, ks = pallas_attention.cache_write_row_quant(
+                ck, cache["ks"], knew, w_rows, layer, interpret=interpret)
+            cv, vs = pallas_attention.cache_write_row_quant(
+                cv, cache["vs"], vnew, w_rows, layer, interpret=interpret)
+            cache = {"k": ck, "v": cv, "ks": ks, "vs": vs}
+            scale_kw = dict(cache_ks=ks, cache_vs=vs)
+        else:
+            ck = pallas_attention.cache_write_row(ck, knew, w_rows, layer,
+                                                  interpret=interpret)
+            cv = pallas_attention.cache_write_row(cv, vnew, w_rows, layer,
+                                                  interpret=interpret)
+            cache = {"k": ck, "v": cv}
+            scale_kw = {}
         if sp == 1:
             ctx = pallas_attention.decode_attend_pallas_layer(
-                q, ck, cv, r_lens, layer, interpret=interpret)
-            return ctx, ck, cv
+                q, ck, cv, r_lens, layer, interpret=interpret, **scale_kw)
+            return ctx, cache
         acc, m, l = pallas_attention.decode_attend_pallas_layer(
-            q, ck, cv, r_lens, layer, interpret=interpret, return_stats=True)
+            q, ck, cv, r_lens, layer, interpret=interpret, return_stats=True,
+            **scale_kw)
         # Merge partial softmaxes across sequence shards. A shard with none
         # of a slot's rows carries (acc=0, m=-inf, l=0); the -inf-safe
         # weights zero it out of the combine.
@@ -129,7 +145,7 @@ def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
         l_glob = jax.lax.psum(l * w, "sp")
         acc_glob = jax.lax.psum(acc * w[..., None], "sp")
         ctx = acc_glob / jnp.maximum(l_glob, 1e-9)[..., None]
-        return ctx[:, None].astype(q.dtype), ck, cv
+        return ctx[:, None].astype(q.dtype), cache
 
     def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, tuple]:
         cache, layer = cache_l
@@ -139,32 +155,39 @@ def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
                 from jax.experimental.shard_map import shard_map
                 from jax.sharding import PartitionSpec as P
 
+                from aws_k8s_ansible_provisioner_tpu.parallel.sharding import (
+                    cache_pspecs)
+
+                # single source of sharding truth: the same specs the Engine
+                # allocates the cache with
+                cache_spec = cache_pspecs(quant=kvc.is_quantized(cache))
                 fn = shard_map(
                     _write_attend, mesh=mesh,
-                    in_specs=(P("dp", None, "tp", None),         # q [B,1,Hq,D]
-                              P(None, "dp", "tp", "sp", None),   # k [L,B,Hkv,S,D]
-                              P(None, "dp", "tp", "sp", None),   # v
-                              P("dp", "tp", None),               # knew [B,Hkv,D]
-                              P("dp", "tp", None),               # vnew
-                              P("dp"),                           # lengths [B]
-                              P()),                              # layer scalar
-                    out_specs=(P("dp", None, "tp", None),
-                               P(None, "dp", "tp", "sp", None),
-                               P(None, "dp", "tp", "sp", None)),
+                    in_specs=(P("dp", None, "tp", None),  # q [B,1,Hq,D]
+                              cache_spec,                 # cache leaf dict
+                              P("dp", "tp", None),        # knew [B,Hkv,D]
+                              P("dp", "tp", None),        # vnew
+                              P("dp"),                    # lengths [B]
+                              P()),                       # layer scalar
+                    out_specs=(P("dp", None, "tp", None), cache_spec),
                     check_rep=False,
                 )
-                ctx, ck, cv = fn(q, cache["k"], cache["v"], knew, vnew,
-                                 lengths, layer)
+                ctx, cache = fn(q, cache, knew, vnew, lengths, layer)
             else:
-                ctx, ck, cv = _write_attend(q, cache["k"], cache["v"],
-                                            knew, vnew, lengths, layer)
-            cache = {"k": ck, "v": cv}
+                ctx, cache = _write_attend(q, cache, knew, vnew, lengths,
+                                           layer)
         else:
             cache = kvc.write_token_layer(cache, layer, lengths, k, v)
-            ck = jax.lax.dynamic_index_in_dim(cache["k"], layer, 0,
-                                              keepdims=False)
-            cv = jax.lax.dynamic_index_in_dim(cache["v"], layer, 0,
-                                              keepdims=False)
+
+            def layer_slice(name):
+                return jax.lax.dynamic_index_in_dim(cache[name], layer, 0,
+                                                    keepdims=False)
+
+            ck, cv = layer_slice("k"), layer_slice("v")
+            if kvc.is_quantized(cache):
+                # model dtype, not f32: attention upcasts internally anyway
+                ck = kvc.dequantize(ck, layer_slice("ks"), dtype=q.dtype)
+                cv = kvc.dequantize(cv, layer_slice("vs"), dtype=q.dtype)
             ctx = decode_attend(q, ck, cv, lengths + 1)
         return ctx, (cache, layer)
 
@@ -228,7 +251,14 @@ def make_chunk_prefill_attend(slot: jnp.ndarray, start: jnp.ndarray):
 
     def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
         cache_l = kvc.write_chunk(cache_l, slot, start, k, v)
-        ctx = chunk_attend(q, cache_l["k"][slot], cache_l["v"][slot], start)
+        ck, cv = cache_l["k"][slot], cache_l["v"][slot]
+        if kvc.is_quantized(cache_l):
+            # Dequantized [Hkv, S, D] slices materialize per layer — a
+            # prefill-only cost that amortizes over the chunk's tokens (the
+            # decode hot loop never does this; its kernels fold the scales).
+            ck = kvc.dequantize(ck, cache_l["ks"][slot], dtype=q.dtype)
+            cv = kvc.dequantize(cv, cache_l["vs"][slot], dtype=q.dtype)
+        ctx = chunk_attend(q, ck, cv, start)
         return ctx, cache_l
 
     return attend

@@ -34,6 +34,32 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _pick_chunk(S: int, chunk: int, interpret: bool, quant: bool) -> int:
+    """Largest legal cache-chunk size dividing the window S.
+
+    On real TPU the block shapes impose tiling rules Mosaic enforces at
+    lowering: the K/V block's sublane dim is the chunk (multiple of 8 for
+    bf16, 32 for int8), and the quantized path's scale block has the chunk on
+    the LANE axis (multiple of 128, or the full dimension). The engine's
+    windows are 256-aligned so the preferred chunk survives; this guard keeps
+    odd windows (or odd sp shards) compiling instead of dying in Mosaic.
+    Interpret mode (CPU tests) has no such constraints.
+    """
+    chunk = min(chunk, S)
+    while S % chunk:
+        chunk -= 1
+    if interpret or chunk == S:
+        return chunk
+    # quant: scale-block lane rule (128) also covers the int8 sublane rule
+    # (32); bf16/f32 caches only need the sublane rule (16 covers both).
+    align = 128 if quant else 16
+    if chunk % align == 0:
+        return chunk
+    best = next((c for c in range(chunk // align * align, align - 1, -align)
+                 if S % c == 0), None)
+    return best if best is not None else S
+
+
 def decode_attend_pallas(q: jnp.ndarray, cache_k: jnp.ndarray,
                          cache_v: jnp.ndarray, lengths: jnp.ndarray,
                          chunk: int = 256, interpret: bool = False) -> jnp.ndarray:
@@ -107,6 +133,83 @@ def _decode_kernel_layer(lengths_ref,      # scalar prefetch [B] int32
         o_ref[0, :, :] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
+def _decode_kernel_layer_q(lengths_ref,     # scalar prefetch [B] int32
+                           layer_ref,       # scalar prefetch [1] int32
+                           q_ref,           # [1, Hq, D]
+                           k_ref,           # [1, 1, Hkv, CHUNK, D] int8
+                           v_ref,           # [1, 1, Hkv, CHUNK, D] int8
+                           ks_ref,          # [1, 1, Hkv, CHUNK] f32 scales
+                           vs_ref,          # [1, 1, Hkv, CHUNK] f32 scales
+                           o_ref, acc_ref, m_ref, l_ref,
+                           *, chunk: int, groups: int, scale: float):
+    """Int8-cache variant of ``_decode_kernel_layer``: K/V stream as int8 (half
+    the HBM traffic of bf16 — the whole point; decode is cache-bandwidth-bound)
+    and dequantization folds into the flash accumulation inside VMEM:
+    ``q·(k_q*ks) == (q·k_q)*ks`` per key column, and ``p·(v_q*vs) ==
+    (p*vs)·v_q`` per value row — the f32 cache never materializes anywhere.
+    """
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    num_chunks = pl.num_programs(1)
+    length = lengths_ref[b]
+    hq, d = q_ref.shape[1], q_ref.shape[2]
+    hkv = k_ref.shape[2]
+
+    @pl.when(c == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(c * chunk < length)
+    def _accumulate():
+        q3 = (q_ref[0].astype(jnp.float32) * scale).reshape(hkv, groups, d)
+        k3 = k_ref[0, 0].astype(jnp.float32)                  # [Hkv, C, D]
+        s = jax.lax.dot_general(
+            q3, k3, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)               # [Hkv, G, C]
+        s = s * ks_ref[0, 0][:, None, :]                      # fold k scales
+        s = s.reshape(hq, chunk)
+        col = c * chunk + jax.lax.broadcasted_iota(jnp.int32, (hq, chunk), 1)
+        s = jnp.where(col < length, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_cur = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        v3 = v_ref[0, 0].astype(jnp.float32)                  # [Hkv, C, D]
+        p3 = p.reshape(hkv, groups, chunk) * vs_ref[0, 0][:, None, :]
+        pv = jax.lax.dot_general(
+            p3, v3, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)               # [Hkv, G, D]
+        acc_ref[:] = acc_ref[:] * corr + pv.reshape(hq, d)
+        m_ref[:, :1] = m_cur
+        l_ref[:, :1] = l_cur
+
+    @pl.when(c == num_chunks - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-9)
+        o_ref[0, :, :] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _decode_kernel_layer_q_stats(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
+                                 ks_ref, vs_ref, o_ref, mo_ref, lo_ref,
+                                 acc_ref, m_ref, l_ref,
+                                 *, chunk: int, groups: int, scale: float):
+    """Stats-emitting int8 variant (sequence-parallel decode merge)."""
+    _decode_kernel_layer_q(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
+                           ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref,
+                           chunk=chunk, groups=groups, scale=scale)
+    c = pl.program_id(1)
+
+    @pl.when(c == pl.num_programs(1) - 1)
+    def _emit_stats():
+        o_ref[0, :, :] = acc_ref[:].astype(o_ref.dtype)  # overwrite normalized
+        mo_ref[0] = jnp.broadcast_to(m_ref[:, :1], mo_ref.shape[1:])
+        lo_ref[0] = jnp.broadcast_to(l_ref[:, :1], lo_ref.shape[1:])
+
+
 def _decode_kernel_layer_stats(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
                                o_ref,       # [1, Hq, D] f32 UNNORMALIZED acc
                                mo_ref,      # [1, Hq, 128] f32 running max
@@ -136,12 +239,19 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
                                cache_v: jnp.ndarray, lengths: jnp.ndarray,
                                layer: jnp.ndarray, chunk: int = 256,
                                interpret: bool = False,
-                               return_stats: bool = False):
+                               return_stats: bool = False,
+                               cache_ks: jnp.ndarray = None,
+                               cache_vs: jnp.ndarray = None):
     """Flash decode attention over ONE layer of the full stacked cache.
 
     q: [B, 1, Hq, D]; cache_k/v: [L, B, Hkv, S, D] (the whole cache buffer —
     no per-layer slice is ever cut); lengths: [B] (counting the just-written
     token); layer: scalar int32. Returns [B, 1, Hq, D].
+
+    With ``cache_ks``/``cache_vs`` ([L, B, Hkv, S] f32) the cache is int8 and
+    the kernel dequantizes in VMEM by folding the per-row scales into the
+    flash accumulation (see _decode_kernel_layer_q) — half the HBM streaming
+    of the bf16 cache.
 
     The hot path of the carry-based decode loop: only the live chunks of the
     selected layer stream HBM→VMEM (same DMA-skip clamping as
@@ -151,9 +261,8 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
     B, _, Hq, D = q.shape
     Hkv, S = cache_k.shape[2], cache_k.shape[3]
     groups = Hq // Hkv
-    chunk = min(chunk, S)
-    while S % chunk:
-        chunk -= 1
+    quant = cache_ks is not None
+    chunk = _pick_chunk(S, chunk, interpret, quant)
     num_chunks = S // chunk
     lengths = lengths.astype(jnp.int32)
     layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
@@ -165,6 +274,10 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
         live = jnp.maximum(pl.cdiv(lens[b], chunk) - 1, 0)
         return (lay[0], b, 0, jnp.minimum(c, live), 0)
 
+    def scale_map(b, c, lens, lay):
+        live = jnp.maximum(pl.cdiv(lens[b], chunk) - 1, 0)
+        return (lay[0], b, 0, jnp.minimum(c, live))
+
     scratch = [
         pltpu.VMEM((Hq, D), jnp.float32),
         pltpu.VMEM((Hq, 128), jnp.float32),
@@ -175,6 +288,13 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
         pl.BlockSpec((1, 1, Hkv, chunk, D), kv_map),
         pl.BlockSpec((1, 1, Hkv, chunk, D), kv_map),
     ]
+    operands = [q[:, 0], cache_k, cache_v]
+    if quant:
+        # chunk on the LANE axis: legal because _pick_chunk forces a
+        # 128-multiple (or full-S) chunk on the compiled path
+        in_specs += [pl.BlockSpec((1, 1, Hkv, chunk), scale_map)] * 2
+        operands += [cache_ks, cache_vs]
+    scale = 1.0 / (D ** 0.5)
     if return_stats:
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
@@ -188,8 +308,9 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
             scratch_shapes=scratch,
         )
         kernel = functools.partial(
-            _decode_kernel_layer_stats, chunk=chunk, groups=groups,
-            scale=1.0 / (D ** 0.5))
+            _decode_kernel_layer_q_stats if quant
+            else _decode_kernel_layer_stats,
+            chunk=chunk, groups=groups, scale=scale)
         acc, m, l = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
@@ -199,7 +320,7 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
                 jax.ShapeDtypeStruct((B, Hq, 128), jnp.float32),
             ],
             interpret=interpret,
-        )(lengths, layer_arr, q[:, 0], cache_k, cache_v)
+        )(lengths, layer_arr, *operands)
         # stats are replicated along the 128-lane axis; take lane 0
         return acc, m[:, :, 0], l[:, :, 0]
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -210,14 +331,14 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
         scratch_shapes=scratch,
     )
     kernel = functools.partial(
-        _decode_kernel_layer, chunk=chunk, groups=groups,
-        scale=1.0 / (D ** 0.5))
+        _decode_kernel_layer_q if quant else _decode_kernel_layer,
+        chunk=chunk, groups=groups, scale=scale)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         interpret=interpret,
-    )(lengths, layer_arr, q[:, 0], cache_k, cache_v)
+    )(lengths, layer_arr, *operands)
     return out[:, None]
 
 
@@ -288,6 +409,86 @@ def cache_write_row(cache: jnp.ndarray, new: jnp.ndarray,
         input_output_aliases={3: 0},   # cache operand (after 2 scalars + new)
         interpret=interpret,
     )(lengths, layer_arr, new, cache)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def cache_write_row_quant(cache: jnp.ndarray, scales: jnp.ndarray,
+                          new: jnp.ndarray, lengths: jnp.ndarray,
+                          layer: jnp.ndarray, interpret: bool = False):
+    """Quantizing variant of :func:`cache_write_row` for the int8 cache.
+
+    cache: [L, B, Hkv, S, D] int8; scales: [L, B, Hkv, S] f32; new: [B, Hkv, D]
+    float. Quantizes each new row per-head in VMEM (round-half-even, matching
+    kv_cache.quantize_rows bit-for-bit so XLA-prefilled and Pallas-decoded
+    rows are interchangeable) and writes the int8 row + its scale IN PLACE
+    (both buffers aliased). Out-of-window rows drop, as in the bf16 kernel.
+    Returns (cache, scales) — same buffers.
+    """
+    L, B, Hkv, S, D = cache.shape
+    lengths = lengths.astype(jnp.int32)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    # int8 arrays tile as (32, 128) on TPU: touch a 32-row block (vs 8 for
+    # bf16). Still ~128 KB in+out per slot — noise next to the full-cache
+    # copies this kernel avoids.
+    ROWS = 32 if S % 32 == 0 else (8 if S % 8 == 0 else S)
+
+    def new_map(b, lens, lay):
+        return (b, 0, 0)
+
+    def blk_map(b, lens, lay):
+        return (lay[0], b, 0, jnp.clip(lens[b], 0, S - 1) // ROWS, 0)
+
+    def scale_map(b, lens, lay):
+        # Full-S scale block: S is the scales array's minormost (lane) axis
+        # and a lane-axis block must be a 128-multiple or the full dimension —
+        # a ROWS-sized block would fail Mosaic lowering. Hkv*S*4 bytes
+        # in+out per slot is still noise next to the copies this avoids.
+        return (lay[0], b, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hkv, D), new_map),
+            pl.BlockSpec((1, 1, Hkv, ROWS, D), blk_map),
+            pl.BlockSpec((1, 1, Hkv, S), scale_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, Hkv, ROWS, D), blk_map),
+            pl.BlockSpec((1, 1, Hkv, S), scale_map),
+        ],
+    )
+
+    def kernel(lengths_ref, layer_ref, new_ref, cin_ref, sin_ref,
+               cout_ref, sout_ref):
+        b = pl.program_id(0)
+        tgt = lengths_ref[b]
+        in_window = (tgt >= 0) & (tgt < S)
+        r = jnp.where(in_window, jnp.clip(tgt, 0, S - 1) % ROWS, -1)
+        # the one shared quantizer (plain jnp ops, valid inside Pallas):
+        # XLA-prefilled and Pallas-decoded rows MUST quantize identically
+        from aws_k8s_ansible_provisioner_tpu.serving.kv_cache import (
+            quantize_rows)
+
+        q8, sc = quantize_rows(new_ref[0])                    # [Hkv,D],[Hkv]
+        row = jax.lax.broadcasted_iota(jnp.int32, (Hkv, ROWS, D), 1)
+        cout_ref[0, 0] = jnp.where(row == r, q8[:, None, :], cin_ref[0, 0])
+        # scale block spans the whole window: target column is tgt itself
+        # (masked to -1 out of window, matching the row-block drop)
+        rs = jax.lax.broadcasted_iota(jnp.int32, (Hkv, S), 1)
+        tgt_col = jnp.where(in_window, tgt, -1)
+        sout_ref[0, 0] = jnp.where(rs == tgt_col, sc[:, None], sin_ref[0, 0])
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(cache.shape, cache.dtype),
+            jax.ShapeDtypeStruct(scales.shape, scales.dtype),
+        ],
+        input_output_aliases={3: 0, 4: 1},  # cache, scales (after 2 scalars + new)
+        interpret=interpret,
+    )(lengths, layer_arr, new, cache, scales)
 
 
 def supported(cfg=None) -> bool:

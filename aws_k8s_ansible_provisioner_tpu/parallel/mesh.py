@@ -26,10 +26,11 @@ from aws_k8s_ansible_provisioner_tpu.config import MeshConfig
 
 
 def make_mesh(mesh_cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
-    """Build a (dp, tp, sp) mesh over the given (or all) devices.
+    """Build a (dp, sp, ep, tp) mesh over the given (or all) devices.
 
-    Axis order puts ``tp`` and ``sp`` innermost so on a real slice they map to
-    ICI-adjacent chips (jax device order is ICI-topology-aware); ``dp`` — the
+    Axis order puts ``tp`` innermost (and ``ep`` next) so on a real slice they
+    map to ICI-adjacent chips (jax device order is ICI-topology-aware): tp
+    psums every matmul and ep all-to-alls every MoE layer, while ``dp`` — the
     axis with the least communication (one gradient psum per step in training,
     none in serving) — gets the outermost, potentially-DCN hops.
     """
@@ -38,10 +39,11 @@ def make_mesh(mesh_cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
     if len(devices) < n:
         raise ValueError(
             f"mesh {mesh_cfg} needs {n} devices, have {len(devices)}")
-    arr = np.asarray(devices[:n]).reshape(mesh_cfg.dp, mesh_cfg.sp, mesh_cfg.tp)
-    # Mesh axis order is (dp, sp, tp); PartitionSpecs refer to axes by name so
-    # the tuple order only controls the device layout, not the sharding API.
-    return Mesh(arr, ("dp", "sp", "tp"))
+    arr = np.asarray(devices[:n]).reshape(
+        mesh_cfg.dp, mesh_cfg.sp, mesh_cfg.ep, mesh_cfg.tp)
+    # PartitionSpecs refer to axes by name so the tuple order only controls
+    # the device layout, not the sharding API.
+    return Mesh(arr, ("dp", "sp", "ep", "tp"))
 
 
 def auto_mesh_config(n_devices: int, want_sp: bool = True,

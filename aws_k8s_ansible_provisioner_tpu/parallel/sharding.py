@@ -100,15 +100,21 @@ def param_pspecs(cfg: ModelConfig) -> dict:
     return specs
 
 
-def cache_pspecs() -> dict:
+def cache_pspecs(quant: bool = False) -> dict:
     """Decode cache [L, slots, Hkv, S, D]: slots over dp, kv heads over tp,
     sequence over sp (no-op on meshes with a size-1 sp axis; with sp > 1 the
     cache window scales with the sp group's aggregate HBM — the long-context
-    serving axis)."""
-    return {
+    serving axis). With ``quant`` the int8 cache's per-row scale leaves
+    ``ks``/``vs`` [L, slots, Hkv, S] shard identically (minus the head_dim
+    axis)."""
+    specs = {
         "k": P(None, "dp", "tp", "sp", None),
         "v": P(None, "dp", "tp", "sp", None),
     }
+    if quant:
+        specs["ks"] = P(None, "dp", "tp", "sp")
+        specs["vs"] = P(None, "dp", "tp", "sp")
+    return specs
 
 
 def tokens_pspec(seq_sharded: bool = False) -> P:

@@ -247,6 +247,13 @@ class Engine:
         self.buckets = tuple(b for b in serving.prefill_buckets
                              if b <= self.max_len)
         dtype = jnp.bfloat16 if serving.dtype == "bfloat16" else jnp.float32
+        if serving.kv_dtype not in ("auto", "int8"):
+            # An unrecognized value (e.g. "fp8", "INT8") must not silently
+            # degrade to the unquantized cache — capacity would halve with no
+            # error until an OOM much later.
+            raise ValueError(f"kv_dtype={serving.kv_dtype!r}: expected "
+                             f"'auto' or 'int8'")
+        self.kv_quant = serving.kv_dtype == "int8"
 
         # Multi-chip serving: a (dp, tp) mesh shards params (Megatron TP),
         # slots over dp, and kv heads over tp (parallel/sharding.py). The
@@ -282,14 +289,14 @@ class Engine:
                 cache_pspecs)
 
             out_sh = {name: NamedSharding(self.mesh, spec)
-                      for name, spec in cache_pspecs().items()}
+                      for name, spec in cache_pspecs(self.kv_quant).items()}
             self.cache = jax.jit(
                 lambda: kvc.init_cache(cfg, self.num_slots, self.max_len,
-                                       dtype),
+                                       dtype, quant=self.kv_quant),
                 out_shardings=out_sh)()
         else:
             self.cache = kvc.init_cache(cfg, self.num_slots, self.max_len,
-                                        dtype)
+                                        dtype, quant=self.kv_quant)
 
         self.metrics = EngineMetrics()
         self._rng = jax.random.PRNGKey(0)

@@ -21,6 +21,17 @@ which is exactly a paged cache whose per-slot block table is the identity —
 
 Raggedness (every slot at a different sequence length) is expressed by a
 ``lengths[num_slots]`` vector and masking, not by dynamic shapes.
+
+**Int8 quantization** (``quant=True`` / ServingConfig.kv_dtype="int8"): K/V rows
+are stored int8 with one float32 scale per (layer, slot, head, row) —
+``ks, vs : [num_layers, num_slots, num_kv_heads, max_len]`` — the standard
+per-token-per-head dynamic scheme (near-lossless for attention; the vLLM
+engine inside the reference's serving pods ships the same option as
+``kv_cache_dtype=int8``). Decode is cache-bandwidth-bound, so halving the
+bytes/row both halves the hot-loop HBM traffic and doubles the slot count a
+chip's HBM can hold; the Pallas kernel dequantizes in VMEM by folding the
+scales into the flash accumulation (ops/pallas_attention.py), so the f32 cache
+never exists in HBM.
 """
 
 from __future__ import annotations
@@ -35,20 +46,76 @@ from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
 
 
 def init_cache(cfg: ModelConfig, num_slots: int, max_len: int,
-               dtype=jnp.bfloat16) -> dict:
-    """Allocate the decode cache. Leaves carry a leading [L] axis for lax.scan."""
+               dtype=jnp.bfloat16, quant: bool = False) -> dict:
+    """Allocate the decode cache. Leaves carry a leading [L] axis for lax.scan.
+
+    With ``quant`` the K/V leaves are int8 and per-row scale leaves ``ks``/``vs``
+    are added (see module docstring).
+    """
     shape = (cfg.num_layers, num_slots, cfg.num_kv_heads, max_len, cfg.head_dim)
+    if quant:
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "ks": jnp.zeros(shape[:-1], jnp.float32),
+            "vs": jnp.zeros(shape[:-1], jnp.float32),
+        }
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
     }
 
 
+def is_quantized(cache_l: dict) -> bool:
+    return "ks" in cache_l
+
+
 def cache_bytes(cfg: ModelConfig, num_slots: int, max_len: int,
-                dtype=jnp.bfloat16) -> int:
-    itemsize = jnp.dtype(dtype).itemsize
-    return (2 * cfg.num_layers * num_slots * max_len * cfg.num_kv_heads
-            * cfg.head_dim * itemsize)
+                dtype=jnp.bfloat16, quant: bool = False) -> int:
+    rows = 2 * cfg.num_layers * num_slots * max_len * cfg.num_kv_heads
+    if quant:
+        return rows * (cfg.head_dim * 1 + 4)   # int8 row + f32 scale
+    return rows * cfg.head_dim * jnp.dtype(dtype).itemsize
+
+
+def quantize_rows(x: jnp.ndarray):
+    """Per-row symmetric int8 quantization over the trailing head_dim axis.
+
+    x: [..., D] float → (int8 [..., D], float32 scale [...]) with
+    ``x ≈ q * scale``. Round-half-even, the same rule as the in-kernel
+    quantization in ops/pallas_attention.cache_write_row_quant, so
+    XLA-prefilled rows and Pallas-decoded rows are interchangeable (agreement
+    to 1 int8 step; compiled-program fusion may differ by 1 ulp of scale).
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-6) / 127.0
+    q = jnp.round(xf / scale[..., None]).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.float32):
+    """Inverse of quantize_rows: q [..., D] int8, scale [...] → float [..., D]."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def _write_kv(cache_l: dict, update, k_val: jnp.ndarray,
+              v_val: jnp.ndarray) -> dict:
+    """Apply one ``update(arr, val)`` expression to the k and v leaves —
+    quantizing the values first and updating the scale leaves with the SAME
+    expression when the cache is int8. That works because every writer's
+    scale target is exactly its row target minus the trailing head_dim axis
+    (quantize_rows drops that axis), so the k/v indexing never has to be
+    written twice (once per dtype branch) per writer."""
+    if is_quantized(cache_l):
+        k_val, ks = quantize_rows(k_val)
+        v_val, vs = quantize_rows(v_val)
+        return {"k": update(cache_l["k"], k_val),
+                "v": update(cache_l["v"], v_val),
+                "ks": update(cache_l["ks"], ks),
+                "vs": update(cache_l["vs"], vs)}
+    return {"k": update(cache_l["k"], k_val),
+            "v": update(cache_l["v"], v_val)}
 
 
 def write_prompt(cache_l: dict, slot: jnp.ndarray, k: jnp.ndarray,
@@ -65,10 +132,11 @@ def write_prompt(cache_l: dict, slot: jnp.ndarray, k: jnp.ndarray,
     v3 = jnp.swapaxes(v[0], 0, 1)
     start = (slot, jnp.zeros_like(slot), jnp.zeros_like(slot),
              jnp.zeros_like(slot))
-    return {
-        "k": jax.lax.dynamic_update_slice(cache_l["k"], k3[None], start),
-        "v": jax.lax.dynamic_update_slice(cache_l["v"], v3[None], start),
-    }
+    return _write_kv(
+        cache_l,
+        lambda arr, val: jax.lax.dynamic_update_slice(arr, val[None],
+                                                      start[:arr.ndim]),
+        k3, v3)
 
 
 def write_prompts(cache_l: dict, slots: jnp.ndarray, k: jnp.ndarray,
@@ -83,10 +151,10 @@ def write_prompts(cache_l: dict, slots: jnp.ndarray, k: jnp.ndarray,
     kt = jnp.swapaxes(k, 1, 2)  # [N, Hkv, T, D]
     vt = jnp.swapaxes(v, 1, 2)
     T = k.shape[1]
-    return {
-        "k": cache_l["k"].at[slots, :, :T].set(kt, mode="drop"),
-        "v": cache_l["v"].at[slots, :, :T].set(vt, mode="drop"),
-    }
+    return _write_kv(
+        cache_l,
+        lambda arr, val: arr.at[slots, :, :T].set(val, mode="drop"),
+        kt, vt)
 
 
 def write_chunk(cache_l: dict, slot: jnp.ndarray, start: jnp.ndarray,
@@ -107,10 +175,10 @@ def write_chunk(cache_l: dict, slot: jnp.ndarray, start: jnp.ndarray,
     # Advanced indices (scalar slot, row vector) separated by the head slice
     # broadcast to the FRONT: the update target is [C, Hkv, D] — exactly the
     # incoming chunk's layout, no transpose needed.
-    return {
-        "k": cache_l["k"].at[slot, :, rows].set(k[0], mode="drop"),
-        "v": cache_l["v"].at[slot, :, rows].set(v[0], mode="drop"),
-    }
+    return _write_kv(
+        cache_l,
+        lambda arr, val: arr.at[slot, :, rows].set(val, mode="drop"),
+        k[0], v[0])
 
 
 def write_token(cache_l: dict, lengths: jnp.ndarray, k: jnp.ndarray,
@@ -123,10 +191,10 @@ def write_token(cache_l: dict, lengths: jnp.ndarray, k: jnp.ndarray,
     rows = jnp.arange(B)
     # Advanced indexing at axes (0, 2) with the head slice between them yields
     # [B, Hkv, D] targets — exactly the incoming token's shape.
-    return {
-        "k": cache_l["k"].at[rows, :, lengths].set(k[:, 0]),
-        "v": cache_l["v"].at[rows, :, lengths].set(v[:, 0]),
-    }
+    return _write_kv(
+        cache_l,
+        lambda arr, val: arr.at[rows, :, lengths].set(val),
+        k[:, 0], v[:, 0])
 
 
 def write_token_layer(cache: dict, layer: jnp.ndarray, lengths: jnp.ndarray,
@@ -143,10 +211,10 @@ def write_token_layer(cache: dict, layer: jnp.ndarray, lengths: jnp.ndarray,
     """
     B = k.shape[0]
     rows = jnp.arange(B)
-    return {
-        "k": cache["k"].at[layer, rows, :, lengths].set(k[:, 0]),
-        "v": cache["v"].at[layer, rows, :, lengths].set(v[:, 0]),
-    }
+    return _write_kv(
+        cache,
+        lambda arr, val: arr.at[layer, rows, :, lengths].set(val, mode="drop"),
+        k[:, 0], v[:, 0])
 
 
 # Donating the cache is what makes this a ~rows-sized copy: the engine
@@ -157,14 +225,16 @@ def write_token_layer(cache: dict, layer: jnp.ndarray, lengths: jnp.ndarray,
 def _copy_prefix(cache: dict, src: jnp.ndarray, dst: jnp.ndarray,
                  n_rows: jnp.ndarray) -> dict:
     def one(arr):
+        # K/V leaves are [L, B, H, S, D]; quant scale leaves are [L, B, H, S]
+        # — the sequence axis is 3 in both, the reshape pads trailing dims.
         S = arr.shape[3]
-        src_s = jax.lax.dynamic_index_in_dim(arr, src, axis=1)   # [L,1,H,S,D]
+        src_s = jax.lax.dynamic_index_in_dim(arr, src, axis=1)   # [L,1,H,S,...]
         dst_s = jax.lax.dynamic_index_in_dim(arr, dst, axis=1)
-        keep = jnp.arange(S)[None, None, None, :, None] < n_rows
-        mixed = jnp.where(keep, src_s, dst_s)
+        keep = jnp.arange(S).reshape((1, 1, 1, S) + (1,) * (arr.ndim - 4))
+        mixed = jnp.where(keep < n_rows, src_s, dst_s)
         return jax.lax.dynamic_update_slice_in_dim(arr, mixed, dst, axis=1)
 
-    return {"k": one(cache["k"]), "v": one(cache["v"])}
+    return {name: one(arr) for name, arr in cache.items()}
 
 
 def copy_prefix(cache: dict, src_slot: int, dst_slot: int, n_rows: int) -> dict:

@@ -472,6 +472,9 @@ def main(argv=None):
     p.add_argument("--max-cache-len", type=int, default=2048)
     p.add_argument("--dtype", default="bfloat16",
                    choices=["bfloat16", "float32"])
+    p.add_argument("--kv-dtype", default="auto", choices=["auto", "int8"],
+                   help="KV-cache storage dtype; int8 halves cache HBM "
+                        "footprint/bandwidth (~2x the decode slots per chip)")
     p.add_argument("--chat-template", default="",
                    help="path to a Jinja chat template file")
     p.add_argument("--platform", default="",
@@ -509,6 +512,7 @@ def main(argv=None):
         model=args.model, port=args.port, host=args.host,
         max_decode_slots=args.max_decode_slots,
         max_cache_len=args.max_cache_len, dtype=args.dtype,
+        kv_dtype=args.kv_dtype,
         checkpoint_dir=args.checkpoint_dir, chat_template=args.chat_template,
         prefill_chunk=args.prefill_chunk,
         prefix_cache=not args.no_prefix_cache,
