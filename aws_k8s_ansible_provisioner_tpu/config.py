@@ -301,6 +301,7 @@ QWEN3_30B_A3B = ModelConfig(
 
 MODEL_REGISTRY = {
     "Qwen/Qwen3-0.6B": QWEN3_0_6B,
+    "Qwen/Qwen3-30B-A3B": QWEN3_30B_A3B,
     "Qwen/Qwen3-8B": QWEN3_8B,
     "microsoft/phi-2": PHI_2,
     "facebook/opt-125m": OPT_125M,

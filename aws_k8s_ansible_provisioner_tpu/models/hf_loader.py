@@ -111,19 +111,36 @@ def convert_state_dict(cfg: ModelConfig, tensors: Dict[str, np.ndarray],
             p["bias"] = stack(hf_fmt + ".bias", transpose=False)
         return p
 
+    def stack_experts(proj: str) -> np.ndarray:
+        """Stack HF per-expert Linears into [L, E, in, out] (transposed)."""
+        return np.stack([
+            np.stack([
+                _get(tensors, layer_pre.format(i=i)
+                     + f"mlp.experts.{e}.{proj}.weight").T
+                for e in range(cfg.num_experts)])
+            for i in range(L)])
+
     layers: dict = {
         "input_norm": norm(input_norm),
         "wq": dense(pre + "q_proj", cfg.attention_bias),
         "wk": dense(pre + "k_proj", cfg.attention_bias),
         "wv": dense(pre + "v_proj", cfg.attention_bias),
         "wo": dense(pre + o_name, cfg.attention_bias),
-        "w_down": dense(layer_pre + down_name, cfg.mlp_bias),
     }
-    if cfg.act == "silu":
+    if cfg.num_experts > 0:
+        # Qwen3-MoE: router = mlp.gate [E, H] → [H, E]; experts stacked.
+        layers["router"] = {"kernel": stack(layer_pre + "mlp.gate.weight",
+                                            transpose=True)}
+        layers["w_gate"] = {"kernel": stack_experts("gate_proj")}
+        layers["w_up"] = {"kernel": stack_experts("up_proj")}
+        layers["w_down"] = {"kernel": stack_experts("down_proj")}
+    elif cfg.act == "silu":
         layers["w_gate"] = dense(layer_pre + "mlp.gate_proj", cfg.mlp_bias)
         layers["w_up"] = dense(layer_pre + "mlp.up_proj", cfg.mlp_bias)
+        layers["w_down"] = dense(layer_pre + down_name, cfg.mlp_bias)
     else:
         layers["w_up"] = dense(layer_pre + up_name, cfg.mlp_bias)
+        layers["w_down"] = dense(layer_pre + down_name, cfg.mlp_bias)
     if cfg.qk_norm:
         layers["q_norm"] = {"weight": stack(pre + "q_norm.weight", False)}
         layers["k_norm"] = {"weight": stack(pre + "k_norm.weight", False)}
@@ -191,6 +208,33 @@ def config_from_hf_dir(checkpoint_dir: str) -> ModelConfig:
     if name in MODEL_REGISTRY:
         return MODEL_REGISTRY[name]
     model_type = hf.get("model_type", "")
+    if model_type == "qwen3_moe":
+        if hf.get("mlp_only_layers") or hf.get("decoder_sparse_step", 1) != 1:
+            raise ValueError("qwen3_moe variants with dense layers mixed in "
+                             "(mlp_only_layers/decoder_sparse_step) are not "
+                             "supported")
+        return ModelConfig(
+            name=name,
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf["num_key_value_heads"],
+            head_dim=hf.get("head_dim",
+                            hf["hidden_size"] // hf["num_attention_heads"]),
+            max_seq_len=hf.get("max_position_embeddings", 4096),
+            rope_theta=hf.get("rope_theta", 1e6),
+            qk_norm=True,
+            norm_eps=hf.get("rms_norm_eps", 1e-6),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            eos_token_id=(hf.get("eos_token_id") or 0),
+            num_experts=hf["num_experts"],
+            num_experts_per_tok=hf["num_experts_per_tok"],
+            moe_intermediate_size=hf["moe_intermediate_size"],
+            norm_topk_prob=hf.get("norm_topk_prob", True),
+            hf_repo=name,
+        )
     if model_type == "qwen3":
         return ModelConfig(
             name=name,

@@ -216,12 +216,19 @@ def init_layer_params(cfg: ModelConfig, key: jax.Array, dtype) -> dict:
     if cfg.qk_norm:
         params["q_norm"] = {"weight": jnp.ones((L, cfg.head_dim), dtype)}
         params["k_norm"] = {"weight": jnp.ones((L, cfg.head_dim), dtype)}
-    if cfg.act == "silu":  # gated SwiGLU MLP (Qwen)
+    if cfg.num_experts > 0:  # MoE (Qwen3-MoE): router + stacked expert FFNs
+        E, Im = cfg.num_experts, cfg.moe_intermediate_size
+        params["router"] = {"kernel": _dense_init(ks[7], (L, H, E), dtype)}
+        params["w_gate"] = {"kernel": _dense_init(ks[4], (L, E, H, Im), dtype)}
+        params["w_up"] = {"kernel": _dense_init(ks[5], (L, E, H, Im), dtype)}
+        params["w_down"] = {"kernel": _dense_init(ks[6], (L, E, Im, H), dtype)}
+    elif cfg.act == "silu":  # gated SwiGLU MLP (Qwen)
         params["w_gate"] = dense(ks[4], H, cfg.intermediate_size, cfg.mlp_bias)
         params["w_up"] = dense(ks[5], H, cfg.intermediate_size, cfg.mlp_bias)
-    else:  # plain 2-matmul MLP (Phi)
+        params["w_down"] = dense(ks[6], cfg.intermediate_size, H, cfg.mlp_bias)
+    else:  # plain 2-matmul MLP (Phi/OPT)
         params["w_up"] = dense(ks[5], H, cfg.intermediate_size, cfg.mlp_bias)
-    params["w_down"] = dense(ks[6], cfg.intermediate_size, H, cfg.mlp_bias)
+        params["w_down"] = dense(ks[6], cfg.intermediate_size, H, cfg.mlp_bias)
     if not cfg.parallel_block:
         params["post_norm"] = norm()
     return params
@@ -267,6 +274,11 @@ def _linear(x, p):
 
 
 def _mlp(cfg: ModelConfig, h: jnp.ndarray, p: dict) -> jnp.ndarray:
+    if cfg.num_experts > 0:  # MoE: router + grouped expert compute (ops/moe)
+        from aws_k8s_ansible_provisioner_tpu.ops.moe import moe_mlp
+
+        B, T, H = h.shape
+        return moe_mlp(cfg, h.reshape(B * T, H), p).reshape(B, T, H)
     if cfg.act == "silu":
         return _linear(jax.nn.silu(_linear(h, p["w_gate"])) * _linear(h, p["w_up"]),
                        p["w_down"])

@@ -1,0 +1,125 @@
+"""Mixture-of-Experts MLP: router + grouped expert compute, TPU-first.
+
+The reference's serving stack gets MoE support from the vLLM engine inside its
+pods (SURVEY.md §2.2 row 1 — the engine is external; fused-MoE CUDA kernels);
+here it is in-repo for the Qwen3-MoE family (config.QWEN3_30B_A3B). Two
+implementations behind one interface, selected by ``ModelConfig.moe_impl``:
+
+- **ragged** (default; exact): tokens sorted by expert id, experts computed
+  with ``jax.lax.ragged_dot`` grouped matmuls — the MegaBlocks/MaxText
+  formulation. No token is ever dropped, so serving quality is bit-stable;
+  this is the single-device/serving path (GSPMD cannot usefully partition the
+  data-dependent group boundaries).
+- **gshard** (distributed): fixed-capacity one-hot dispatch/combine einsums —
+  the GShard formulation. Every shape is static and every op is a plain
+  einsum, so GSPMD partitions the expert axis over the mesh's ``ep`` axis and
+  inserts the all-to-all-style collectives itself (the same
+  compiler-emits-the-comms design as the rest of parallel/sharding.py).
+  Tokens beyond an expert's capacity contribute nothing (their MLP output is
+  zero and the residual stream carries them) — standard GShard semantics,
+  tunable via ``moe_capacity_factor``.
+
+Router math matches HF ``Qwen3MoeSparseMoeBlock``: softmax over ALL experts in
+float32, top-k, optional renormalization over the k weights, weights applied
+to expert outputs in the activation dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
+
+
+def route(cfg: ModelConfig, x: jnp.ndarray, router_kernel: jnp.ndarray):
+    """Top-k routing. x: [N, H]; router_kernel: [H, E].
+
+    Returns (weights [N, k] in x.dtype, expert_idx [N, k] int32).
+    """
+    logits = x.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                    # [N, E]
+    w, idx = jax.lax.top_k(probs, cfg.num_experts_per_tok)     # [N, k]
+    if cfg.norm_topk_prob:
+        w = w / jnp.maximum(w.sum(axis=-1, keepdims=True), 1e-9)
+    return w.astype(x.dtype), idx.astype(jnp.int32)
+
+
+def _expert_ffn_ragged(x: jnp.ndarray, p: dict, group_sizes: jnp.ndarray):
+    """SwiGLU over sorted token groups: x [M, H] grouped by expert;
+    kernels [E, H, I] / [E, I, H]."""
+    g = jax.lax.ragged_dot(x, p["w_gate"]["kernel"], group_sizes)
+    u = jax.lax.ragged_dot(x, p["w_up"]["kernel"], group_sizes)
+    return jax.lax.ragged_dot(jax.nn.silu(g) * u,
+                              p["w_down"]["kernel"], group_sizes)
+
+
+def moe_mlp_ragged(cfg: ModelConfig, x: jnp.ndarray, p: dict) -> jnp.ndarray:
+    """Exact no-drop MoE MLP. x: [N, H] flattened tokens → [N, H].
+
+    Sort the N*k (token, expert) assignments by expert id, run three grouped
+    matmuls over the contiguous groups (``ragged_dot`` keeps the MXU fed
+    without materializing per-expert gathers of static worst-case size), then
+    weighted-scatter the outputs back. O(N*k) FLOPs through the experts —
+    the sparse compute MoE promises, with zero dropped tokens.
+    """
+    N, H = x.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    w, idx = route(cfg, x, p["router"]["kernel"])
+    flat_e = idx.reshape(-1)                                   # [N*k]
+    order = jnp.argsort(flat_e)                                # stable
+    tok = order // k                                           # source token
+    xs = x[tok]                                                # [N*k, H]
+    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+    ys = _expert_ffn_ragged(xs, p, group_sizes)                # [N*k, H]
+    wflat = w.reshape(-1)[order]
+    out = jnp.zeros_like(x)
+    return out.at[tok].add((ys * wflat[:, None]).astype(x.dtype))
+
+
+def gshard_capacity(cfg: ModelConfig, n_tokens: int) -> int:
+    """Per-expert token capacity (static): cf * ceil(N*k/E), floor 4, rounded
+    up to a multiple of 4 so the dispatched [E, C, H] block tiles cleanly."""
+    mean = -(-n_tokens * cfg.num_experts_per_tok // cfg.num_experts)
+    cap = max(4, int(mean * cfg.moe_capacity_factor))
+    return -(-cap // 4) * 4
+
+
+def moe_mlp_gshard(cfg: ModelConfig, x: jnp.ndarray, p: dict) -> jnp.ndarray:
+    """Fixed-capacity dispatch MoE MLP. x: [N, H] → [N, H].
+
+    dispatch/combine are [N, E, C] one-hot/weight tensors; every contraction
+    is a static einsum, so with expert kernels sharded P(None, "ep", ...) and
+    activations batch-sharded, GSPMD partitions expert compute over ``ep``
+    and emits the token exchange over ICI — no hand-written all_to_all.
+    """
+    N, H = x.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    C = gshard_capacity(cfg, N)
+    w, idx = route(cfg, x, p["router"]["kernel"])
+    # Queue position of each (token, choice) within its expert, in flat
+    # (token-major) arrival order; positions >= C overflow and drop.
+    onehot_e = jax.nn.one_hot(idx.reshape(-1), E, dtype=jnp.int32)  # [N*k, E]
+    pos = (jnp.cumsum(onehot_e, axis=0) - onehot_e)                 # [N*k, E]
+    pos = (pos * onehot_e).sum(-1).reshape(N, k)                    # [N, k]
+    keep = (pos < C).astype(x.dtype)
+    onehot_c = jax.nn.one_hot(pos, C, dtype=x.dtype)                # [N, k, C]
+    oe = onehot_e.reshape(N, k, E).astype(x.dtype)
+    combine = jnp.einsum("nk,nke,nkc->nec", w * keep, oe, onehot_c)
+    dispatch = jnp.einsum("nk,nke,nkc->nec", keep, oe, onehot_c)
+    xe = jnp.einsum("nec,nh->ech", dispatch, x)                     # [E, C, H]
+    g = jnp.einsum("ech,ehi->eci", xe, p["w_gate"]["kernel"])
+    u = jnp.einsum("ech,ehi->eci", xe, p["w_up"]["kernel"])
+    y = jnp.einsum("eci,eih->ech", jax.nn.silu(g) * u,
+                   p["w_down"]["kernel"])                           # [E, C, H]
+    return jnp.einsum("nec,ech->nh", combine, y).astype(x.dtype)
+
+
+def moe_mlp(cfg: ModelConfig, x: jnp.ndarray, p: dict) -> jnp.ndarray:
+    """Dispatch on cfg.moe_impl. x: [N, H] flattened tokens."""
+    if cfg.moe_impl == "gshard":
+        return moe_mlp_gshard(cfg, x, p)
+    if cfg.moe_impl == "ragged":
+        return moe_mlp_ragged(cfg, x, p)
+    raise ValueError(f"moe_impl={cfg.moe_impl!r}: expected 'ragged' or "
+                     f"'gshard'")

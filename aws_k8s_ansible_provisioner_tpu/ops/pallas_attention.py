@@ -428,9 +428,11 @@ def cache_write_row_quant(cache: jnp.ndarray, scales: jnp.ndarray,
     lengths = lengths.astype(jnp.int32)
     layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
     # int8 arrays tile as (32, 128) on TPU: touch a 32-row block (vs 8 for
-    # bf16). Still ~128 KB in+out per slot — noise next to the full-cache
-    # copies this kernel avoids.
-    ROWS = 32 if S % 32 == 0 else (8 if S % 8 == 0 else S)
+    # bf16), falling back to the FULL window when 32 doesn't divide it (an
+    # 8-row fallback would violate the int8 sublane rule in Mosaic). Still
+    # ~128 KB in+out per slot — noise next to the full-cache copies this
+    # kernel avoids.
+    ROWS = 32 if S % 32 == 0 else S
 
     def new_map(b, lens, lay):
         return (b, 0, 0)

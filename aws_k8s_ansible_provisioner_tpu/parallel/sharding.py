@@ -30,15 +30,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
 
 
-def check_tp_divisibility(cfg: ModelConfig, tp: int) -> None:
-    """TP must evenly split query heads, kv heads, and the MLP intermediate."""
-    for name, dim in (("num_heads", cfg.num_heads),
-                      ("num_kv_heads", cfg.num_kv_heads),
-                      ("intermediate_size", cfg.intermediate_size),
-                      ("vocab_size", cfg.vocab_size)):
+def check_tp_divisibility(cfg: ModelConfig, tp: int, ep: int = 1) -> None:
+    """TP must evenly split query heads, kv heads, and the MLP intermediate
+    (the MoE expert intermediate when sparse); ep must split the experts."""
+    dims = [("num_heads", cfg.num_heads),
+            ("num_kv_heads", cfg.num_kv_heads),
+            ("vocab_size", cfg.vocab_size)]
+    if cfg.num_experts > 0:
+        dims.append(("moe_intermediate_size", cfg.moe_intermediate_size))
+    else:
+        dims.append(("intermediate_size", cfg.intermediate_size))
+    for name, dim in dims:
         if dim % tp != 0:
             raise ValueError(f"tp={tp} does not divide {name}={dim} "
                              f"for model {cfg.name}")
+    if ep > 1 and cfg.num_experts % ep != 0:
+        raise ValueError(f"ep={ep} does not divide num_experts="
+                         f"{cfg.num_experts} for model {cfg.name}")
 
 
 def _layer_pspecs(cfg: ModelConfig) -> dict:
@@ -72,10 +80,20 @@ def _layer_pspecs(cfg: ModelConfig) -> dict:
     if cfg.qk_norm:
         specs["q_norm"] = {"weight": P(None, None)}
         specs["k_norm"] = {"weight": P(None, None)}
-    if cfg.act == "silu":
-        specs["w_gate"] = col(cfg.mlp_bias)
-    specs["w_up"] = col(cfg.mlp_bias)
-    specs["w_down"] = row(cfg.mlp_bias)
+    if cfg.num_experts > 0:
+        # MoE: experts sharded over ep, each expert Megatron-split over tp
+        # (gate/up column-parallel on the expert intermediate, down row-
+        # parallel); the tiny router replicates. GSPMD derives the gshard
+        # dispatch collectives from these specs (ops/moe.py).
+        specs["router"] = {"kernel": P(None, None, None)}
+        specs["w_gate"] = {"kernel": P(None, "ep", None, "tp")}
+        specs["w_up"] = {"kernel": P(None, "ep", None, "tp")}
+        specs["w_down"] = {"kernel": P(None, "ep", "tp", None)}
+    else:
+        if cfg.act == "silu":
+            specs["w_gate"] = col(cfg.mlp_bias)
+        specs["w_up"] = col(cfg.mlp_bias)
+        specs["w_down"] = row(cfg.mlp_bias)
     if not cfg.parallel_block:
         specs["post_norm"] = norm()
     return specs

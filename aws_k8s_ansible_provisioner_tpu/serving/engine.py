@@ -268,7 +268,12 @@ class Engine:
             tp = self.mesh.shape["tp"]
             dp = self.mesh.shape["dp"]
             sp = self.mesh.shape.get("sp", 1)
-            check_tp_divisibility(cfg, tp)
+            check_tp_divisibility(cfg, tp, self.mesh.shape.get("ep", 1))
+            if cfg.num_experts > 0 and cfg.moe_impl != "gshard":
+                # Distributed MoE must use the GSPMD-partitionable dispatch
+                # formulation; ragged_dot's data-dependent groups would make
+                # the compiler all-gather every expert (ops/moe.py).
+                cfg = self.cfg = cfg.scaled(moe_impl="gshard")
             if self.num_slots % dp:
                 raise ValueError(f"max_decode_slots={self.num_slots} must be "
                                  f"divisible by dp={dp}")

@@ -398,6 +398,12 @@ def build_state(serving_cfg=None, model_cfg=None, params=None,
                                    eos_token_id=tokenizer.eos_token_id,
                                    num_layers=4, hidden_size=128,
                                    intermediate_size=256)
+        elif serving.model == "tiny-qwen3-moe":
+            from aws_k8s_ansible_provisioner_tpu.config import tiny_qwen3_moe
+
+            model_cfg = tiny_qwen3_moe(vocab_size=tokenizer.vocab_size,
+                                       eos_token_id=tokenizer.eos_token_id,
+                                       num_layers=4, hidden_size=128)
         else:
             raise ValueError(f"unknown model {serving.model!r} and no checkpoint")
 
@@ -488,6 +494,9 @@ def main(argv=None):
                    help="sequence-parallel degree (shards the KV cache's "
                         "sequence axis — the long-context axis; decode "
                         "merges per-shard flash partials over ICI)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel degree (MoE models: shards experts "
+                        "over the mesh; GSPMD emits the dispatch collectives)")
     p.add_argument("--prefill-chunk", type=int, default=0,
                    help="chunked prefill size; 0 disables (long prompts "
                         "then cap at the largest bucket)")
@@ -516,7 +525,7 @@ def main(argv=None):
         checkpoint_dir=args.checkpoint_dir, chat_template=args.chat_template,
         prefill_chunk=args.prefill_chunk,
         prefix_cache=not args.no_prefix_cache,
-        mesh=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp))
+        mesh=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep))
     state = build_state(serving)
     if not args.no_warmup:
         log.info("warmup: compiling %d prefill buckets + decode ...",

@@ -161,6 +161,35 @@ def test_engine_int8_token_parity_across_backends(impl):
     assert eng.cache["k"].dtype == jnp.int8
 
 
+@pytest.mark.parametrize("sp", [1, 2])
+def test_engine_int8_mesh_token_parity(cpu_devices, sp):
+    """Mesh + int8 together: shard_map'd quant cache specs, the quantizing
+    Pallas write kernel per shard, and (sp=2) the quant stats emission merged
+    across sequence shards — token parity with the single-device int8 engine.
+    """
+    from aws_k8s_ansible_provisioner_tpu.config import MeshConfig
+    from aws_k8s_ansible_provisioner_tpu.parallel import make_mesh
+
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(2, cfg.vocab_size, n).tolist() for n in (3, 9, 14)]
+    base = ServingConfig(max_decode_slots=4, max_cache_len=64,
+                         prefill_buckets=(16,), dtype="float32",
+                         kv_dtype="int8", attention_impl="pallas",
+                         prefix_cache=False)
+    ref, _ = _run_engine(cfg, params, base, prompts)
+    mesh = make_mesh(MeshConfig(dp=2, tp=2, sp=sp),
+                     devices=jax.devices()[:4 * sp])
+    eng = Engine(cfg, params, base, mesh=mesh)
+    reqs = [eng.submit(Request(prompt_ids=list(p), max_tokens=6,
+                               ignore_eos=True)) for p in prompts]
+    for _ in range(10000):
+        if not eng.step():
+            break
+    assert [r.generated for r in reqs] == ref
+
+
 def test_engine_int8_prefix_cache_copies_scales():
     """copy_prefix must move the scale rows with the int8 rows: a prefix hit
     into a quantized cache serves the same tokens as a cold engine."""
