@@ -463,14 +463,16 @@ class MeshConfig:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    # Pipeline stages (parallel/pipeline.py GPipe schedule over ppermute).
+    pp: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.tp * self.sp * self.ep
+        return self.dp * self.tp * self.sp * self.ep * self.pp
 
     @property
     def axis_names(self):
-        return ("dp", "sp", "ep", "tp")
+        return ("dp", "pp", "sp", "ep", "tp")
 
 
 # ---------------------------------------------------------------------------

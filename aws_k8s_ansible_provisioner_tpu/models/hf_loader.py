@@ -112,13 +112,22 @@ def convert_state_dict(cfg: ModelConfig, tensors: Dict[str, np.ndarray],
         return p
 
     def stack_experts(proj: str) -> np.ndarray:
-        """Stack HF per-expert Linears into [L, E, in, out] (transposed)."""
-        return np.stack([
-            np.stack([
-                _get(tensors, layer_pre.format(i=i)
-                     + f"mlp.experts.{e}.{proj}.weight").T
-                for e in range(cfg.num_experts)])
-            for i in range(L)])
+        """Stack HF per-expert Linears into [L, E, in, out] (transposed).
+
+        Assigns expert-by-expert into a preallocated TARGET-dtype array so
+        peak host memory is the final stacked leaf plus ONE expert matrix —
+        a naive np.stack of float32 intermediates would transiently need
+        ~2x-4x the checkpoint (116 GB for Qwen3-30B-A3B vs ~58 GB here).
+        """
+        first = _get(tensors,
+                     layer_pre.format(i=0) + f"mlp.experts.0.{proj}.weight")
+        out = np.empty((L, cfg.num_experts) + first.T.shape, jnp.dtype(dtype))
+        for i in range(L):
+            for e in range(cfg.num_experts):
+                w = _get(tensors, layer_pre.format(i=i)
+                         + f"mlp.experts.{e}.{proj}.weight")
+                out[i, e] = w.T.astype(out.dtype)
+        return out
 
     layers: dict = {
         "input_norm": norm(input_norm),

@@ -39,11 +39,15 @@ def make_mesh(mesh_cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
     if len(devices) < n:
         raise ValueError(
             f"mesh {mesh_cfg} needs {n} devices, have {len(devices)}")
+    # Single source of truth for axis names/order: MeshConfig.axis_names
+    # (innermost = hottest collectives: tp psums every matmul, ep all-to-alls
+    # every MoE layer, pp ppermutes once per pipeline tick, dp psums once per
+    # step). PartitionSpecs refer to axes by name, so the order here only
+    # controls the device layout.
+    names = mesh_cfg.axis_names
     arr = np.asarray(devices[:n]).reshape(
-        mesh_cfg.dp, mesh_cfg.sp, mesh_cfg.ep, mesh_cfg.tp)
-    # PartitionSpecs refer to axes by name so the tuple order only controls
-    # the device layout, not the sharding API.
-    return Mesh(arr, ("dp", "sp", "ep", "tp"))
+        [getattr(mesh_cfg, a) for a in names])
+    return Mesh(arr, names)
 
 
 def auto_mesh_config(n_devices: int, want_sp: bool = True,

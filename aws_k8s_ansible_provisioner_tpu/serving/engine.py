@@ -272,7 +272,17 @@ class Engine:
             if cfg.num_experts > 0 and cfg.moe_impl != "gshard":
                 # Distributed MoE must use the GSPMD-partitionable dispatch
                 # formulation; ragged_dot's data-dependent groups would make
-                # the compiler all-gather every expert (ops/moe.py).
+                # the compiler all-gather every expert (ops/moe.py). This
+                # trades the exact no-drop impl for capacity-limited dispatch
+                # — say so, loudly, or a quality difference vs single-device
+                # serving is undiagnosable.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "MoE under a mesh: switching moe_impl ragged -> gshard "
+                    "(capacity_factor=%s; tokens past an expert's capacity "
+                    "fall back to the residual stream)",
+                    cfg.moe_capacity_factor)
                 cfg = self.cfg = cfg.scaled(moe_impl="gshard")
             if self.num_slots % dp:
                 raise ValueError(f"max_decode_slots={self.num_slots} must be "

@@ -113,6 +113,24 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, optimizer,
     runs as ring attention over the sp axis; otherwise sequence is replicated).
     Donates the state so params/opt buffers update in place in HBM.
     """
+    from aws_k8s_ansible_provisioner_tpu.parallel.sharding import (
+        check_tp_divisibility)
+
+    tp = mesh.shape.get("tp", 1)
+    ep = mesh.shape.get("ep", 1)
+    check_tp_divisibility(cfg, tp, ep)
+    if cfg.num_experts > 0 and (ep > 1 or tp > 1) \
+            and cfg.moe_impl != "gshard":
+        # Same guard as the serving engine: sharded expert weights + the
+        # ragged impl's data-dependent groups would make GSPMD all-gather
+        # every expert stack per layer (ops/moe.py).
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "MoE under an ep/tp mesh: switching moe_impl ragged -> gshard "
+            "(capacity_factor=%s; overflow tokens fall back to the residual "
+            "stream)", cfg.moe_capacity_factor)
+        cfg = cfg.scaled(moe_impl="gshard")
     attend = make_ring_attend(mesh) if seq_parallel else None
     data_sharding = NamedSharding(mesh, tokens_pspec(seq_sharded=seq_parallel))
 
