@@ -525,6 +525,19 @@ class ServingConfig:
     # add dispatches (same-slot reuse, would-chunk-anyway prompts) are
     # always taken. See Engine._hit_pays.
     prefix_cache_payback_rows: int = 256
+    # Prompt-lookup speculative decoding (the vLLM feature of the same name):
+    # draft the next spec_k tokens by matching the context's trailing
+    # spec_ngram against its own history, verify all drafts in ONE forward
+    # pass (one cache stream answers every draft — decode is bandwidth-bound,
+    # so accepted drafts are nearly free tokens). Greedy-lossless: accepted
+    # tokens are exactly what plain greedy decode would emit; sampled
+    # (temperature > 0) slots fall back to one token per step. Single-device
+    # path (per-slot accept lengths are data-dependent, which would desync
+    # dp shards). Wins on repetitive continuations (code, quoting, RAG);
+    # costs one extra model-width of FLOPs per step when nothing matches.
+    spec_decode: bool = False
+    spec_k: int = 4
+    spec_ngram: int = 3
     max_tokens_default: int = 256
     dtype: str = "bfloat16"
     # KV-cache storage dtype: "auto" follows ``dtype``; "int8" stores K/V rows

@@ -194,6 +194,98 @@ def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
     return attend
 
 
+def decode_attend_multi(q: jnp.ndarray, cache_k: jnp.ndarray,
+                        cache_v: jnp.ndarray, base_lens: jnp.ndarray
+                        ) -> jnp.ndarray:
+    """XLA fallback for speculative verify: R query rows per slot.
+
+    q: [B, R, Hq, D]; cache_k/v: [B, Hkv, S, D] (rows base..base+R-1 already
+    written); query row r sees columns < base_lens + 1 + r. Returns
+    [B, R, Hq, D].
+    """
+    B, R, Hq, D = q.shape
+    Hkv, S = cache_k.shape[1], cache_k.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, R, Hkv, G, D).astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    logits = jnp.einsum("brkgd,bksd->brkgs", qg,
+                        cache_k.astype(jnp.float32)) * scale
+    limit = base_lens[:, None] + 1 + jnp.arange(R)[None, :]    # [B, R]
+    valid = jnp.arange(S)[None, None, :] < limit[:, :, None]   # [B, R, S]
+    logits = jnp.where(valid[:, :, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    ctx = jnp.einsum("brkgs,bksd->brkgd", probs,
+                     cache_v.astype(jnp.float32))
+    return ctx.reshape(B, R, Hq, D).astype(q.dtype)
+
+
+def make_spec_attend_carry(lengths: jnp.ndarray, impl: str = "auto"):
+    """Carry-path attend for SPECULATIVE verify: R tokens per slot per step.
+
+    Same cache-in-scan-carry structure as make_decode_attend_carry, but the
+    incoming q/k/v carry R rows (last accepted token + R-1 prompt-lookup
+    drafts): all R K/V rows are written at positions lengths..lengths+R-1
+    (in-place Pallas row writes, R static unrolled — each a ~rows-sized DMA),
+    then one flash pass answers all R queries against one cache stream
+    (decode_attend_pallas_spec). Rows past the eventually-accepted prefix
+    hold garbage K/V beyond the slot's new length — overwritten when those
+    positions are next processed, the engine's standard surplus-write
+    invariant. Single-device path (mesh speculation is out of scope: the
+    accept length is data-dependent per dp shard, which would desync the
+    shards' fused horizons).
+    """
+    resolved = resolve_impl(impl)
+
+    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, tuple]:
+        cache, layer = cache_l
+        B, R = q.shape[0], q.shape[1]
+        if resolved == "pallas":
+            from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
+
+            interpret = jax.default_backend() != "tpu"
+            quant = kvc.is_quantized(cache)
+            ck, cv = cache["k"], cache["v"]
+            if quant:
+                ks, vs = cache["ks"], cache["vs"]
+                for r in range(R):
+                    ck, ks = pallas_attention.cache_write_row_quant(
+                        ck, ks, k[:, r], lengths + r, layer,
+                        interpret=interpret)
+                    cv, vs = pallas_attention.cache_write_row_quant(
+                        cv, vs, v[:, r], lengths + r, layer,
+                        interpret=interpret)
+                cache = {"k": ck, "v": cv, "ks": ks, "vs": vs}
+                scale_kw = dict(cache_ks=ks, cache_vs=vs)
+            else:
+                for r in range(R):
+                    ck = pallas_attention.cache_write_row(
+                        ck, k[:, r], lengths + r, layer, interpret=interpret)
+                    cv = pallas_attention.cache_write_row(
+                        cv, v[:, r], lengths + r, layer, interpret=interpret)
+                cache = {"k": ck, "v": cv}
+                scale_kw = {}
+            ctx = pallas_attention.decode_attend_pallas_spec(
+                q, ck, cv, lengths, layer, interpret=interpret, **scale_kw)
+            return ctx, (cache, layer)
+        # XLA fallback: scatter all R rows, then the multi-query masked attend
+        for r in range(R):
+            cache = kvc.write_token_layer(cache, layer, lengths + r,
+                                          k[:, r:r + 1], v[:, r:r + 1])
+
+        def layer_slice(name):
+            return jax.lax.dynamic_index_in_dim(cache[name], layer, 0,
+                                                keepdims=False)
+
+        ck, cv = layer_slice("k"), layer_slice("v")
+        if kvc.is_quantized(cache):
+            ck = kvc.dequantize(ck, layer_slice("ks"), dtype=q.dtype)
+            cv = kvc.dequantize(cv, layer_slice("vs"), dtype=q.dtype)
+        ctx = decode_attend_multi(q, ck, cv, lengths)
+        return ctx, (cache, layer)
+
+    return attend
+
+
 def make_prefill_attend_batch(slots: jnp.ndarray, seq_lens: jnp.ndarray):
     """Attend callback for BATCHED prefill: N prompts into N slots at once.
 

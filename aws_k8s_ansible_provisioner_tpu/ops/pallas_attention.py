@@ -342,6 +342,151 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
     return out[:, None]
 
 
+def _spec_accumulate(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                     o_ref, acc_ref, m_ref, l_ref,
+                     *, chunk: int, groups: int, scale: float, R: int):
+    """Shared body for the R-draft speculative decode kernels.
+
+    q_ref: [1, R*Hq, D] — R query rows per slot (the last accepted token plus
+    R-1 draft continuations), rows ordered (draft, head). Query row r may see
+    cache columns < lengths[b] + 1 + r (its own just-written row included).
+    The K/V chunk streams ONCE per grid step and is reused by all R queries —
+    the whole point of verifying drafts in one pass: R tokens for one cache
+    read. ks/vs fold int8 scales when present (None = bf16 cache).
+    """
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    num_chunks = pl.num_programs(1)
+    length = lengths_ref[b]
+    d = q_ref.shape[2]
+    hkv = k_ref.shape[2]
+    hq = q_ref.shape[1] // R
+
+    @pl.when(c == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(c * chunk < length + R)
+    def _accumulate():
+        k3 = k_ref[0, 0].astype(jnp.float32)                  # [Hkv, C, D]
+        v3 = v_ref[0, 0].astype(jnp.float32)
+        for r in range(R):                                    # static unroll
+            sl = slice(r * hq, (r + 1) * hq)
+            q3 = (q_ref[0, sl].astype(jnp.float32) * scale
+                  ).reshape(hkv, groups, d)
+            s = jax.lax.dot_general(
+                q3, k3, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)           # [Hkv, G, C]
+            if ks_ref is not None:
+                s = s * ks_ref[0, 0][:, None, :]
+            s = s.reshape(hq, chunk)
+            col = c * chunk + jax.lax.broadcasted_iota(
+                jnp.int32, (hq, chunk), 1)
+            s = jnp.where(col < length + 1 + r, s, NEG_INF)
+            m_prev = m_ref[sl, :1]
+            l_prev = l_ref[sl, :1]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            corr = jnp.exp(m_prev - m_cur)
+            p = jnp.exp(s - m_cur)
+            l_cur = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+            p3 = p.reshape(hkv, groups, chunk)
+            if vs_ref is not None:
+                p3 = p3 * vs_ref[0, 0][:, None, :]
+            pv = jax.lax.dot_general(
+                p3, v3, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)           # [Hkv, G, D]
+            acc_ref[sl] = acc_ref[sl] * corr + pv.reshape(hq, d)
+            m_ref[sl, :1] = m_cur
+            l_ref[sl, :1] = l_cur
+
+    @pl.when(c == num_chunks - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-9)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _spec_kernel_plain(lengths_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc_ref, m_ref, l_ref, **kw):
+    _spec_accumulate(lengths_ref, q_ref, k_ref, v_ref, None, None,
+                     o_ref, acc_ref, m_ref, l_ref, **kw)
+
+
+def _spec_kernel_quant(lengths_ref, layer_ref, q_ref, k_ref, v_ref, ks_ref,
+                       vs_ref, o_ref, acc_ref, m_ref, l_ref, **kw):
+    _spec_accumulate(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                     o_ref, acc_ref, m_ref, l_ref, **kw)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "interpret"))
+def decode_attend_pallas_spec(q: jnp.ndarray, cache_k: jnp.ndarray,
+                              cache_v: jnp.ndarray, lengths: jnp.ndarray,
+                              layer: jnp.ndarray, chunk: int = 256,
+                              interpret: bool = False,
+                              cache_ks: jnp.ndarray = None,
+                              cache_vs: jnp.ndarray = None) -> jnp.ndarray:
+    """Speculative-verify flash attention: R query rows per slot in one pass.
+
+    q: [B, R, Hq, D] — row r is the query at position lengths[b] + r (the
+    caller has already written all R K/V rows); returns [B, R, Hq, D]. Each
+    query row masks to its own causal frontier (lengths + 1 + r). One cache
+    stream serves all R rows, so verifying R-1 drafts costs ~one decode
+    step's HBM traffic — the bandwidth economics that make prompt-lookup
+    speculation profitable on a bandwidth-bound chip.
+    """
+    B, R, Hq, D = q.shape
+    Hkv, S = cache_k.shape[2], cache_k.shape[3]
+    groups = Hq // Hkv
+    quant = cache_ks is not None
+    chunk = _pick_chunk(S, chunk, interpret, quant)
+    num_chunks = S // chunk
+    lengths = lengths.astype(jnp.int32)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+
+    def q_map(b, c, lens, lay):
+        return (b, 0, 0)
+
+    def kv_map(b, c, lens, lay):
+        live = jnp.maximum(pl.cdiv(lens[b] + R, chunk) - 1, 0)
+        return (lay[0], b, 0, jnp.minimum(c, live), 0)
+
+    def scale_map(b, c, lens, lay):
+        live = jnp.maximum(pl.cdiv(lens[b] + R, chunk) - 1, 0)
+        return (lay[0], b, 0, jnp.minimum(c, live))
+
+    in_specs = [
+        pl.BlockSpec((1, R * Hq, D), q_map),
+        pl.BlockSpec((1, 1, Hkv, chunk, D), kv_map),
+        pl.BlockSpec((1, 1, Hkv, chunk, D), kv_map),
+    ]
+    operands = [q.reshape(B, R * Hq, D), cache_k, cache_v]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1, Hkv, chunk), scale_map)] * 2
+        operands += [cache_ks, cache_vs]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, num_chunks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, R * Hq, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((R * Hq, D), jnp.float32),
+            pltpu.VMEM((R * Hq, 128), jnp.float32),
+            pltpu.VMEM((R * Hq, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _spec_kernel_quant if quant else _spec_kernel_plain,
+        chunk=chunk, groups=groups, scale=1.0 / (D ** 0.5), R=R)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, R * Hq, D), q.dtype),
+        interpret=interpret,
+    )(lengths, layer_arr, *operands)
+    return out.reshape(B, R, Hq, D)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def cache_write_row(cache: jnp.ndarray, new: jnp.ndarray,
                     lengths: jnp.ndarray, layer: jnp.ndarray,

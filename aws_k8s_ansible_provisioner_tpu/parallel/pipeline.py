@@ -130,18 +130,28 @@ def make_pipeline_lm_loss(cfg: ModelConfig, mesh: Mesh, n_microbatches: int,
             x = jnp.where(pp_idx == 0, x0.astype(jnp.float32),
                           x_in).astype(x0.dtype)
             y = stage_fwd(p_stage, x, cos, sin)
-            # last stage: masked-CE partials for microbatch t - (PP-1)
+            # last stage: masked-CE partials for microbatch t - (PP-1).
+            # lax.cond, not a mask: the [H, V] head matmul is often the
+            # largest matmul per tick and SPMD stages CAN branch on their
+            # own axis index — only the last stage pays for it.
             out_mb = t - (PP - 1)
             tgt_toks = tokens[jnp.clip(out_mb, 0, M - 1)]
             tgt_mask = loss_mask[jnp.clip(out_mb, 0, M - 1)]
-            logits = _final_logits(params, cfg, y).astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-            nll = -jnp.take_along_axis(
-                logp, tgt_toks[:, 1:][..., None], axis=-1)[..., 0]
-            m = tgt_mask[:, 1:].astype(jnp.float32)
+
+            def ce_partials(y):
+                logits = _final_logits(params, cfg, y).astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, tgt_toks[:, 1:][..., None], axis=-1)[..., 0]
+                m = tgt_mask[:, 1:].astype(jnp.float32)
+                return (nll * m).sum(), m.sum()
+
             valid = (pp_idx == PP - 1) & (out_mb >= 0)
-            nll_sum = nll_sum + jnp.where(valid, (nll * m).sum(), 0.0)
-            cnt_sum = cnt_sum + jnp.where(valid, m.sum(), 0.0)
+            d_nll, d_cnt = jax.lax.cond(
+                valid, ce_partials,
+                lambda y: (jnp.float32(0.0), jnp.float32(0.0)), y)
+            nll_sum = nll_sum + d_nll
+            cnt_sum = cnt_sum + d_cnt
             # hand the activation to the right neighbor for the next tick
             y_next = jax.lax.ppermute(
                 y.astype(jnp.float32), "pp",
@@ -163,6 +173,13 @@ def make_pipeline_lm_loss(cfg: ModelConfig, mesh: Mesh, n_microbatches: int,
     def loss(params, tokens, loss_mask):
         B, T = tokens.shape
         dp = mesh.shape.get("dp", 1)
+        pp = mesh.shape["pp"]
+        stage_dim = jax.tree.leaves(params["layers"])[0].shape[0]
+        if stage_dim != pp:
+            # A mismatch would silently shard stage_dim over pp devices and
+            # shard_body's x[0] would DROP layers — wrong loss, no error.
+            raise ValueError(f"params are staged for pp={stage_dim} but the "
+                             f"mesh has pp={pp} (to_pipeline_params mismatch)")
         if B % (M * dp):
             raise ValueError(f"batch {B} must split into {M} microbatches "
                              f"x dp={dp}")

@@ -49,6 +49,7 @@ from aws_k8s_ansible_provisioner_tpu.ops.attention import (
     make_decode_attend_carry,
     make_prefill_attend,
     make_prefill_attend_batch,
+    make_spec_attend_carry,
 )
 from aws_k8s_ansible_provisioner_tpu.ops.sampling import sample
 from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
@@ -208,6 +209,47 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
     rngs = jax.random.split(rng, n_steps)
     (cache, _, _), out = jax.lax.scan(body, (cache, tokens, lengths), rngs)
     return cache, out
+
+
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("impl",),
+         donate_argnums=(3,))
+def spec_decode_step(cfg: ModelConfig, R: int, params, cache, tokens,
+                     lengths, rng, temperature, top_k, top_p,
+                     impl: str = "auto"):
+    """Speculative verify: R tokens per slot in ONE dispatch.
+
+    tokens: [B, R] = [last accepted token, spec_k prompt-lookup drafts];
+    returns (cache, out [B, R], accepted [B]) where out[b, :accepted[b]] are
+    the emitted tokens (accepted draft prefix + one correction/bonus token
+    from the target model). Greedy-lossless: a greedy slot's emitted tokens
+    are exactly the plain-decode sequence — the verify pass computes the
+    target model's argmax at every draft position and accepts only the
+    matching prefix. Sampled slots (temperature > 0) accept nothing and
+    sample one token from position 0, preserving their distribution.
+
+    K/V rows for all R positions are written in place; rows past the
+    accepted prefix are garbage BEYOND the slot's new length and get
+    overwritten when those positions are next processed (the engine's
+    standard surplus-write invariant — see decode_steps).
+    """
+    B = tokens.shape[0]
+    positions = lengths[:, None] + jnp.arange(R, dtype=jnp.int32)[None, :]
+    attend = make_spec_attend_carry(lengths, impl=impl)
+    logits, cache = model_forward_carry(params, cfg, tokens, positions,
+                                        cache, attend)
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # [B, R]
+    drafts = tokens[:, 1:]                                     # [B, R-1]
+    match = (drafts == preds[:, :-1]).astype(jnp.int32)
+    m = jnp.cumprod(match, axis=-1).sum(axis=-1)               # [B]
+    greedy = temperature <= 0.0
+    m = jnp.where(greedy, m, 0)
+    sampled0 = sample(logits[:, 0], rng, temperature, top_k, top_p)
+    correction = jnp.where(greedy, preds[jnp.arange(B), m], sampled0)
+    pos = jnp.arange(R - 1, dtype=jnp.int32)[None, :]
+    out = jnp.where(pos < m[:, None], drafts, 0)
+    out = jnp.concatenate([out, jnp.zeros((B, 1), jnp.int32)], axis=1)
+    out = out.at[jnp.arange(B), m].set(correction)
+    return cache, out, m + 1
 
 
 # ---------------------------------------------------------------------------
@@ -737,6 +779,76 @@ class Engine:
             self._chunk = None
             self._activate(req, slot, int(token))
 
+    def _propose_drafts(self, active: List[int]):
+        """Prompt-lookup drafts per active slot: match the context's trailing
+        spec_ngram against its own history (numpy sliding-window compare,
+        rightmost hit wins) and propose the following spec_k tokens. Returns
+        [num_slots, spec_k] int32, or None when nothing matched anywhere
+        (the step then falls back to plain fused decode)."""
+        K = self.serving.spec_k
+        n = self.serving.spec_ngram
+        drafts = np.zeros((self.num_slots, K), np.int32)
+        proposed: List[int] = []
+        for slot in active:
+            req = self.slot_req[slot]
+            # Only greedy slots can accept drafts (sampled slots always fall
+            # back to one token); proposing for them would burn verify FLOPs.
+            if req.temperature > 0.0:
+                continue
+            ctx = req.prompt_ids + req.generated
+            if len(ctx) < n + 2:
+                continue
+            arr = np.asarray(ctx[-2048:], np.int32)
+            tgt = arr[-n:]
+            win = np.lib.stride_tricks.sliding_window_view(arr[:-1], n)
+            hits = np.nonzero((win == tgt).all(axis=1))[0]
+            if hits.size == 0:
+                continue
+            cont = arr[int(hits[-1]) + n:][:K]
+            if cont.size == 0:
+                continue
+            drafts[slot, :cont.size] = cont
+            proposed.append(slot)
+        return (drafts, proposed) if proposed else None
+
+    def _do_spec_decode(self, active: List[int], drafts,
+                        proposed: List[int]) -> None:
+        """One speculative verify dispatch: up to spec_k + 1 tokens per slot."""
+        t0 = time.monotonic()
+        R = self.serving.spec_k + 1
+        tokens = np.concatenate([self.last_token[:, None], drafts], axis=1)
+        self.cache, out, accepted = spec_decode_step(
+            self.cfg, R, self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(self.lengths), self._next_rng(),
+            jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+            jnp.asarray(self.top_ps), impl=self.serving.attention_impl)
+        out = np.asarray(out)
+        accepted = np.asarray(accepted)
+        dt = time.monotonic() - t0
+        self.metrics.device_busy_seconds.inc(dt)
+        proposed_set = set(proposed)
+        emitted = 0
+        for slot in active:
+            acc = int(accepted[slot])
+            if slot in proposed_set:  # acceptance rate over REAL proposals
+                self.metrics.spec_drafted_tokens.inc(self.serving.spec_k)
+                self.metrics.spec_accepted_tokens.inc(acc - 1)
+            for i in range(acc):
+                if self.slot_req[slot] is None:
+                    break  # hit a stop condition mid-prefix
+                self.lengths[slot] += 1
+                self.sched.note_decode(slot, 1)
+                self._emit(slot, int(out[slot, i]))
+                emitted += 1
+        self.metrics.decode_step_duration.observe(
+            dt / max(1.0, emitted / max(1, len(active))))
+        self._tok_times.append((t0, emitted))
+        if len(self._tok_times) >= 2:
+            span = time.monotonic() - self._tok_times[0][0]
+            toks = sum(n for _, n in self._tok_times)
+            if span > 0:
+                self.metrics.tokens_per_second.set(toks / span)
+
     def _do_decode(self, max_horizon: Optional[int] = None):
         t0 = time.monotonic()
         active = self._active_slots()
@@ -750,6 +862,16 @@ class Engine:
         horizon = 1 if prefill_possible else max(1, self.serving.decode_horizon)
         if max_horizon is not None:
             horizon = min(horizon, max_horizon)
+        # Speculative path: only when nothing is waiting (prefill priority
+        # stands) and single-device (accept lengths are data-dependent per
+        # slot; a dp mesh would desync). Falls back when no context matched.
+        if (self.serving.spec_decode and self.mesh is None and horizon > 1
+                and self.lengths[active].max(initial=0) + self.serving.spec_k
+                + 1 < self.max_len):
+            proposal = self._propose_drafts(active)
+            if proposal is not None:
+                self._do_spec_decode(active, *proposal)
+                return
         self.cache, out = decode_steps(
             self.cfg, horizon, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
@@ -923,6 +1045,15 @@ class Engine:
             drain()
             self.submit(Request(prompt_ids=list(seed) + [tok + 1] * 8,
                                 max_tokens=1, ignore_eos=True))
+            drain()
+        # Speculative-verify program: a self-repeating prompt guarantees the
+        # prompt-lookup proposer fires, compiling spec_decode_step.
+        if self.serving.spec_decode and self.mesh is None:
+            n = self.serving.spec_ngram
+            pat = [11, 12, 13][:max(1, min(3, n))]
+            r = Request(prompt_ids=(pat * (2 + (2 * n) // len(pat)))[:self.prompt_limit],
+                        max_tokens=self.serving.spec_k + 2, ignore_eos=True)
+            self.submit(r)
             drain()
         # compile the fused decode program too (horizon path)
         horizon = max(1, self.serving.decode_horizon)

@@ -169,6 +169,12 @@ class EngineMetrics:
         self.prefix_tokens_reused = r.register(Counter(
             "tpu_serve_prefix_tokens_reused_total",
             "Prompt tokens served from the prefix cache instead of prefill"))
+        self.spec_drafted_tokens = r.register(Counter(
+            "tpu_serve_spec_drafted_tokens_total",
+            "Draft tokens proposed by prompt-lookup speculative decoding"))
+        self.spec_accepted_tokens = r.register(Counter(
+            "tpu_serve_spec_accepted_tokens_total",
+            "Draft tokens accepted by the verify pass"))
 
     def mark_request(self, status: str, duration_s: float):
         self.request_total.inc(status=status)

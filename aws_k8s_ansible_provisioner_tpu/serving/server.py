@@ -502,6 +502,11 @@ def main(argv=None):
                         "then cap at the largest bucket)")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable automatic prompt-prefix K/V reuse")
+    p.add_argument("--spec-decode", action="store_true",
+                   help="prompt-lookup speculative decoding (greedy-lossless "
+                        "multi-token steps; single-device only)")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft tokens per speculative step")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
@@ -525,6 +530,7 @@ def main(argv=None):
         checkpoint_dir=args.checkpoint_dir, chat_template=args.chat_template,
         prefill_chunk=args.prefill_chunk,
         prefix_cache=not args.no_prefix_cache,
+        spec_decode=args.spec_decode, spec_k=args.spec_k,
         mesh=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep))
     state = build_state(serving)
     if not args.no_warmup:
