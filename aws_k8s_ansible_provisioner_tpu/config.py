@@ -629,6 +629,9 @@ def ansible_vars(cfg: FrameworkConfig | None = None) -> str:
     d["serving_tp"] = cfg.serving.mesh.tp
     d["serving_dp"] = cfg.serving.mesh.dp
     d["serving_sp"] = cfg.serving.mesh.sp
+    d["serving_ep"] = cfg.serving.mesh.ep
+    d["serving_kv_dtype"] = cfg.serving.kv_dtype
+    d["serving_spec_decode"] = cfg.serving.spec_decode
     lines = ["# generated by aws_k8s_ansible_provisioner_tpu.config — do not edit"]
     for k, v in d.items():
         lines.append(f"{k}: {json.dumps(v)}")

@@ -4,3 +4,10 @@ from aws_k8s_ansible_provisioner_tpu.training.trainer import (  # noqa: F401
     make_train_step,
     init_train_state,
 )
+from aws_k8s_ansible_provisioner_tpu.training.loop import (  # noqa: F401
+    latest_checkpoint,
+    restore_train_state,
+    save_train_state,
+    synthetic_data_fn,
+    train,
+)

@@ -84,8 +84,36 @@ def init_train_state(cfg: ModelConfig, mesh: Mesh, optimizer,
         is_leaf=lambda x: isinstance(x, P))
     opt_init = jax.jit(optimizer.init, out_shardings=opt_shardings)
     opt_state = opt_init(params)
-    return TrainState(params=params, opt_state=opt_state,
-                      step=jnp.zeros((), jnp.int32))
+    # step carries an explicit replicated mesh sharding so a checkpoint
+    # restore (which places every leaf with the template's sharding) never
+    # mixes single-device and mesh-wide leaves in one donated jit call.
+    step = jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P()))
+    return TrainState(params=params, opt_state=opt_state, step=step)
+
+
+def abstract_train_state(cfg: ModelConfig, mesh: Mesh, optimizer,
+                         dtype=jnp.float32) -> TrainState:
+    """A TrainState of jax.ShapeDtypeStruct leaves carrying the mesh
+    shardings — the checkpoint-restore template. Nothing is allocated: a
+    resume restores straight into sharded buffers without first
+    materializing a throwaway random init (which would double peak HBM at
+    exactly the 8B scale the sharded design exists for)."""
+    pspecs = param_pspecs(cfg)
+    shardings = param_shardings(mesh, cfg)
+    p_shapes = jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0),
+                                                  dtype))
+    params = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        p_shapes, shardings)
+    opt_shapes = jax.eval_shape(optimizer.init, params)
+    opt_pspecs = _opt_state_pspecs(optimizer, params, pspecs)
+    opt_state = jax.tree.map(
+        lambda s, spec: jax.ShapeDtypeStruct(
+            s.shape, s.dtype, sharding=NamedSharding(mesh, spec)),
+        opt_shapes, opt_pspecs)
+    step = jax.ShapeDtypeStruct((), jnp.int32,
+                                sharding=NamedSharding(mesh, P()))
+    return TrainState(params=params, opt_state=opt_state, step=step)
 
 
 def _opt_state_pspecs(optimizer, params, pspecs):
