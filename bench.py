@@ -142,7 +142,7 @@ def measure() -> None:
         # Large fused horizon amortizes host->device dispatch (the chip is
         # network-attached under the bench harness, ~100 ms RTT/dispatch);
         # serving keeps the smaller default so streaming latency stays bounded.
-        decode_horizon=int(env("TPU_BENCH_HORIZON", 64 if on_tpu else 4)),
+        decode_horizon=int(env("TPU_BENCH_HORIZON", 96 if on_tpu else 4)),
         # Prefilling 16 queued prompts per dispatch keeps the burst TTFT
         # dispatch-count low (8 dispatches for the 128-slot fill).
         max_prefill_batch=16 if on_tpu else 4,
@@ -173,9 +173,13 @@ def measure() -> None:
     # slot, so size the window within the per-slot budget (all slots stay
     # active throughout) and count ACTUAL emitted tokens via the metrics
     # counter, not steps * slots.
+    # Budget already consumed before the timed window: prefill's first token
+    # plus the 3 warmup steps (3 * horizon tokens/slot). Keep one horizon of
+    # slack; a too-generous slack made large horizons compute a NEGATIVE step
+    # count (the r2 horizon-128 sweep failure mode).
     horizon = max(1, serving.decode_horizon)
-    target_steps = min(100, (gen_budget - 8 * horizon) // horizon) if on_tpu \
-        else 4
+    target_steps = min(100, max(1, (gen_budget - 4 * horizon - 8) // horizon)) \
+        if on_tpu else 4
     jax.block_until_ready(engine.cache["k"])
     toks0 = engine.metrics.generated_tokens.total()
     t0 = time.monotonic()
