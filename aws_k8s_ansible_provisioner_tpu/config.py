@@ -39,7 +39,8 @@ class ModelConfig:
     - ``parallel_block``: Phi-style parallel attention+MLP residual block.
     - ``rotary_pct``: fraction of head_dim that is rotated (Phi-2 uses 0.4);
       1.0 means full-dim RoPE (Qwen).
-    - ``act``: "silu" → SwiGLU gated MLP; "gelu_new"/"relu" → plain 2-matrix MLP.
+    - ``act``: "silu" → SwiGLU and "gelu_tanh" → GeGLU (both GATED 2-projection
+      MLPs, see ``gated_mlp``); "gelu_new"/"relu" → plain 2-matrix MLP.
     - ``pos_embed``: "rope" or "learned" (OPT: learned absolute positions with
       the family's +2 offset).
     - ``rope_scaling``: "none" or "llama3" (the Llama-3.1+ frequency-dependent
@@ -66,7 +67,13 @@ class ModelConfig:
     rope_original_max_pos: int = 8192
     norm: str = "rmsnorm"
     norm_eps: float = 1e-6
+    # Gemma convention: RMSNorm weight is zero-centered (applied as 1 + w)
+    # and the token embedding is scaled by sqrt(hidden_size).
+    norm_zero_centered: bool = False
+    embed_scale: bool = False
     qk_norm: bool = False
+    # "silu" (SwiGLU, Qwen/Llama) and "gelu_tanh" (GeGLU, Gemma) are GATED
+    # two-projection MLPs; "gelu_new"/"relu" are plain two-matmul MLPs.
     act: str = "silu"
     pos_embed: str = "rope"
     attention_bias: bool = False
@@ -96,6 +103,10 @@ class ModelConfig:
     moe_impl: str = "ragged"
     moe_capacity_factor: float = 2.0
     hf_repo: str = ""
+
+    @property
+    def gated_mlp(self) -> bool:
+        return self.act in ("silu", "gelu_tanh")
 
     @property
     def q_size(self) -> int:
@@ -277,6 +288,26 @@ TINYLLAMA_1_1B = ModelConfig(
     hf_repo="TinyLlama/TinyLlama-1.1B-Chat-v1.0",
 )
 
+GEMMA_2B = ModelConfig(
+    name="google/gemma-2b",
+    vocab_size=256000,
+    hidden_size=2048,
+    intermediate_size=16384,
+    num_layers=18,
+    num_heads=8,
+    num_kv_heads=1,            # MQA
+    head_dim=256,
+    max_seq_len=8192,
+    rope_theta=10000.0,
+    norm_zero_centered=True,
+    embed_scale=True,
+    act="gelu_tanh",
+    tie_embeddings=True,
+    bos_token_id=2,
+    eos_token_id=1,
+    hf_repo="google/gemma-2b",
+)
+
 QWEN3_30B_A3B = ModelConfig(
     name="Qwen/Qwen3-30B-A3B",
     vocab_size=151936,
@@ -306,6 +337,7 @@ MODEL_REGISTRY = {
     "microsoft/phi-2": PHI_2,
     "facebook/opt-125m": OPT_125M,
     "facebook/opt-1.3b": OPT_1_3B,
+    "google/gemma-2b": GEMMA_2B,
     "meta-llama/Llama-3.2-1B": LLAMA_3_2_1B,
     "meta-llama/Llama-3.1-8B": LLAMA_3_1_8B,
     "TinyLlama/TinyLlama-1.1B-Chat-v1.0": TINYLLAMA_1_1B,
@@ -360,6 +392,30 @@ def tiny_qwen3_moe(**overrides) -> ModelConfig:
         num_experts=8,
         num_experts_per_tok=2,
         moe_intermediate_size=32,
+    )
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+def tiny_gemma(**overrides) -> ModelConfig:
+    """A miniature Gemma-shaped config (zero-centered norms, scaled embed,
+    GeGLU, MQA)."""
+    base = dict(
+        name="tiny-gemma",
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=1,
+        head_dim=16,
+        max_seq_len=128,
+        rope_theta=10000.0,
+        norm_zero_centered=True,
+        embed_scale=True,
+        act="gelu_tanh",
+        tie_embeddings=True,
+        eos_token_id=1,
     )
     base.update(overrides)
     return ModelConfig(**base)

@@ -143,7 +143,7 @@ def convert_state_dict(cfg: ModelConfig, tensors: Dict[str, np.ndarray],
         layers["w_gate"] = {"kernel": stack_experts("gate_proj")}
         layers["w_up"] = {"kernel": stack_experts("up_proj")}
         layers["w_down"] = {"kernel": stack_experts("down_proj")}
-    elif cfg.act == "silu":
+    elif cfg.gated_mlp:  # SwiGLU (Qwen/Llama) / GeGLU (Gemma): same HF names
         layers["w_gate"] = dense(layer_pre + "mlp.gate_proj", cfg.mlp_bias)
         layers["w_up"] = dense(layer_pre + "mlp.up_proj", cfg.mlp_bias)
         layers["w_down"] = dense(layer_pre + down_name, cfg.mlp_bias)
@@ -297,6 +297,28 @@ def config_from_hf_dir(checkpoint_dir: str) -> ModelConfig:
             # NOT the first entry) — the engine checks the whole set.
             eos_token_id=eos_list[0],
             extra_eos_token_ids=tuple(eos_list[1:]),
+            hf_repo=name,
+        )
+    if model_type == "gemma":
+        return ModelConfig(
+            name=name,
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads", 1),
+            head_dim=hf.get("head_dim",
+                            hf["hidden_size"] // hf["num_attention_heads"]),
+            max_seq_len=hf.get("max_position_embeddings", 8192),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            norm_eps=hf.get("rms_norm_eps", 1e-6),
+            norm_zero_centered=True,
+            embed_scale=True,
+            act="gelu_tanh",
+            tie_embeddings=hf.get("tie_word_embeddings", True),
+            bos_token_id=hf.get("bos_token_id", 2),
+            eos_token_id=(hf.get("eos_token_id") or 1),
             hf_repo=name,
         )
     if model_type == "phi":

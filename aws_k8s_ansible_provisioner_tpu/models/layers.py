@@ -44,13 +44,20 @@ AttendFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray, Any],
 # ---------------------------------------------------------------------------
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
-    """RMSNorm with float32 accumulation (matches HF Qwen3 semantics)."""
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float,
+             zero_centered: bool = False) -> jnp.ndarray:
+    """RMSNorm with float32 accumulation (matches HF Qwen3 semantics).
+
+    ``zero_centered`` applies the weight as ``1 + w`` (Gemma convention: the
+    checkpoint stores deviations from identity)."""
     dtype = x.dtype
     x = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     x = x * jax.lax.rsqrt(var + eps)
-    return (x * weight.astype(jnp.float32)).astype(dtype)
+    w = weight.astype(jnp.float32)
+    if zero_centered:
+        w = 1.0 + w
+    return (x * w).astype(dtype)
 
 
 def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
@@ -65,7 +72,8 @@ def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
 
 def apply_norm(cfg: ModelConfig, x: jnp.ndarray, p: dict) -> jnp.ndarray:
     if cfg.norm == "rmsnorm":
-        return rms_norm(x, p["weight"], cfg.norm_eps)
+        return rms_norm(x, p["weight"], cfg.norm_eps,
+                        zero_centered=cfg.norm_zero_centered)
     return layer_norm(x, p["weight"], p["bias"], cfg.norm_eps)
 
 
@@ -222,7 +230,7 @@ def init_layer_params(cfg: ModelConfig, key: jax.Array, dtype) -> dict:
         params["w_gate"] = {"kernel": _dense_init(ks[4], (L, E, H, Im), dtype)}
         params["w_up"] = {"kernel": _dense_init(ks[5], (L, E, H, Im), dtype)}
         params["w_down"] = {"kernel": _dense_init(ks[6], (L, E, Im, H), dtype)}
-    elif cfg.act == "silu":  # gated SwiGLU MLP (Qwen)
+    elif cfg.gated_mlp:  # SwiGLU (Qwen/Llama) / GeGLU (Gemma)
         params["w_gate"] = dense(ks[4], H, cfg.intermediate_size, cfg.mlp_bias)
         params["w_up"] = dense(ks[5], H, cfg.intermediate_size, cfg.mlp_bias)
         params["w_down"] = dense(ks[6], cfg.intermediate_size, H, cfg.mlp_bias)
@@ -279,8 +287,10 @@ def _mlp(cfg: ModelConfig, h: jnp.ndarray, p: dict) -> jnp.ndarray:
 
         B, T, H = h.shape
         return moe_mlp(cfg, h.reshape(B * T, H), p).reshape(B, T, H)
-    if cfg.act == "silu":
-        return _linear(jax.nn.silu(_linear(h, p["w_gate"])) * _linear(h, p["w_up"]),
+    if cfg.gated_mlp:  # SwiGLU (Qwen/Llama) / GeGLU (Gemma)
+        gate_act = jax.nn.silu if cfg.act == "silu" \
+            else partial(jax.nn.gelu, approximate=True)  # "gelu_tanh"
+        return _linear(gate_act(_linear(h, p["w_gate"])) * _linear(h, p["w_up"]),
                        p["w_down"])
     if cfg.act == "relu":  # OPT
         act = jax.nn.relu
@@ -323,6 +333,10 @@ def _embed_inputs(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                   positions: jnp.ndarray):
     """Shared forward preamble: token embedding + position tables."""
     x = params["embed"]["weight"][tokens]
+    if cfg.embed_scale:
+        # Gemma scales embeddings by sqrt(H); HF casts the scalar to the
+        # embedding dtype BEFORE multiplying — match that for logit parity.
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
     if cfg.pos_embed == "learned":
         # OPT: absolute learned positions, +2 offset; no rotary tables needed
         # (dummy cos/sin keep the scan signature uniform).

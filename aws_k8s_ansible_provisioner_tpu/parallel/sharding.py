@@ -90,7 +90,7 @@ def _layer_pspecs(cfg: ModelConfig) -> dict:
         specs["w_up"] = {"kernel": P(None, "ep", None, "tp")}
         specs["w_down"] = {"kernel": P(None, "ep", "tp", None)}
     else:
-        if cfg.act == "silu":
+        if cfg.gated_mlp:
             specs["w_gate"] = col(cfg.mlp_bias)
         specs["w_up"] = col(cfg.mlp_bias)
         specs["w_down"] = row(cfg.mlp_bias)

@@ -404,6 +404,12 @@ def build_state(serving_cfg=None, model_cfg=None, params=None,
             model_cfg = tiny_qwen3_moe(vocab_size=tokenizer.vocab_size,
                                        eos_token_id=tokenizer.eos_token_id,
                                        num_layers=4, hidden_size=128)
+        elif serving.model == "tiny-gemma":
+            from aws_k8s_ansible_provisioner_tpu.config import tiny_gemma
+
+            model_cfg = tiny_gemma(vocab_size=tokenizer.vocab_size,
+                                   eos_token_id=tokenizer.eos_token_id,
+                                   num_layers=4, hidden_size=128)
         else:
             raise ValueError(f"unknown model {serving.model!r} and no checkpoint")
 

@@ -60,8 +60,11 @@ def test_launch_writes_contract_files():
     # the inventory + details files are THE layer handoff (SURVEY.md §1 L1 row)
     assert "tpu-inventory-" in text
     assert "tpu-instance-" in text and "-details.txt" in text
-    # play 2 must run against the provisioned host group
-    assert plays[1]["hosts"] == "tpu_instances"
+    # play 2 preps EVERY worker of the slice (multi-host: tpu_workers ⊇ the
+    # tpu_instances head that L2..L5 target)
+    assert plays[1]["hosts"] == "tpu_workers"
+    assert "[tpu_workers]" in text and "[tpu_instances]" in text
+    assert "worker_count=" in text
 
 
 def test_cluster_playbook_has_five_layer_parity():

@@ -100,6 +100,30 @@ def _hf_llama(cfg):
     return LlamaForCausalLM(hf_cfg).eval()
 
 
+def _hf_gemma(cfg):
+    import torch
+    from transformers import GemmaConfig
+    from transformers.models.gemma.modeling_gemma import GemmaForCausalLM
+
+    hf_cfg = GemmaConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        max_position_embeddings=cfg.max_seq_len,
+        rms_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rope_theta,
+        hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=True,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    return GemmaForCausalLM(hf_cfg).eval()
+
+
 def _hf_opt(cfg):
     import torch
     from transformers import OPTConfig
@@ -124,9 +148,11 @@ def _hf_opt(cfg):
 
 
 @pytest.mark.parametrize("family", ["qwen3", "phi", "opt", "llama",
-                                    "llama_unscaled"])
+                                    "llama_unscaled", "gemma"])
 def test_logits_match_hf(family):
     import torch
+
+    from aws_k8s_ansible_provisioner_tpu.config import tiny_gemma
 
     builders = {"qwen3": (tiny_qwen3, _hf_qwen3), "phi": (tiny_phi, _hf_phi),
                 "opt": (tiny_opt, _hf_opt),
@@ -136,7 +162,9 @@ def test_logits_match_hf(family):
                     lambda: tiny_llama(rope_scaling="none",
                                        rope_theta=10000.0,
                                        tie_embeddings=False),
-                    _hf_llama)}
+                    _hf_llama),
+                # zero-centered norms + scaled embed + GeGLU + MQA
+                "gemma": (tiny_gemma, _hf_gemma)}
     mk_cfg, mk_model = builders[family]
     cfg = mk_cfg()
     model = mk_model(cfg)
@@ -247,3 +275,31 @@ def test_engine_caps_cache_at_model_position_range():
         max_decode_slots=2, max_cache_len=512, prefill_buckets=(8,),
         dtype="float32"))
     assert eng.max_len == 64
+
+
+def test_gemma_engine_decode_pallas_mqa():
+    """Gemma's MQA (num_kv_heads=1) through the serving engine on the Pallas
+    (interpret) path — one KV stream shared by all query heads exercises the
+    kernel's GQA grouping at its extreme; parity vs the XLA fallback."""
+    import jax
+    from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_gemma
+    from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+    from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
+
+    cfg = tiny_gemma()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(2, cfg.vocab_size, n).tolist() for n in (3, 9)]
+
+    def run(impl):
+        eng = Engine(cfg, params, ServingConfig(
+            max_decode_slots=2, max_cache_len=64, prefill_buckets=(16,),
+            dtype="float32", attention_impl=impl, prefix_cache=False))
+        reqs = [eng.submit(Request(prompt_ids=list(p), max_tokens=6,
+                                   ignore_eos=True)) for p in prompts]
+        for _ in range(10000):
+            if not eng.step():
+                break
+        return [r.generated for r in reqs]
+
+    assert run("pallas") == run("xla")

@@ -78,4 +78,5 @@ def test_two_process_mesh_matches_single_process():
         cwd=REPO, env=_env(8), capture_output=True, text=True, timeout=420)
     assert ref.returncode == 0, ref.stdout + ref.stderr
     m = re.search(r"loss=([-\d.]+)", ref.stdout)
+    assert m, f"no loss line in:\n{ref.stdout[-2000:]}"
     np.testing.assert_allclose(losses[0], float(m.group(1)), rtol=1e-5)
