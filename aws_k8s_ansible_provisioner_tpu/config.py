@@ -58,6 +58,13 @@ class ModelConfig:
     num_kv_heads: int
     head_dim: int
     max_seq_len: int = 4096
+    # Sliding-window attention (Mistral-v0.1 style): every position attends
+    # only the last ``sliding_window`` keys; 0 = full causal. Applied
+    # consistently across prefill masks, the XLA decode fallback, and the
+    # Pallas decode kernels — where chunks entirely BELOW the window are
+    # skipped at the DMA level, bounding per-token cache reads at long
+    # contexts (ops/pallas_attention.py).
+    sliding_window: int = 0
     rope_theta: float = 10000.0
     rotary_pct: float = 1.0
     rope_scaling: str = "none"
@@ -288,6 +295,24 @@ TINYLLAMA_1_1B = ModelConfig(
     hf_repo="TinyLlama/TinyLlama-1.1B-Chat-v1.0",
 )
 
+MISTRAL_7B_V01 = ModelConfig(
+    name="mistralai/Mistral-7B-v0.1",
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    max_seq_len=32768,
+    sliding_window=4096,
+    rope_theta=10000.0,
+    tie_embeddings=False,
+    bos_token_id=1,
+    eos_token_id=2,
+    hf_repo="mistralai/Mistral-7B-v0.1",
+)
+
 GEMMA_2B = ModelConfig(
     name="google/gemma-2b",
     vocab_size=256000,
@@ -338,6 +363,7 @@ MODEL_REGISTRY = {
     "facebook/opt-125m": OPT_125M,
     "facebook/opt-1.3b": OPT_1_3B,
     "google/gemma-2b": GEMMA_2B,
+    "mistralai/Mistral-7B-v0.1": MISTRAL_7B_V01,
     "meta-llama/Llama-3.2-1B": LLAMA_3_2_1B,
     "meta-llama/Llama-3.1-8B": LLAMA_3_1_8B,
     "TinyLlama/TinyLlama-1.1B-Chat-v1.0": TINYLLAMA_1_1B,
@@ -392,6 +418,27 @@ def tiny_qwen3_moe(**overrides) -> ModelConfig:
         num_experts=8,
         num_experts_per_tok=2,
         moe_intermediate_size=32,
+    )
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+def tiny_mistral(**overrides) -> ModelConfig:
+    """A miniature Mistral-shaped config (sliding-window attention, GQA)."""
+    base = dict(
+        name="tiny-mistral",
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_seq_len=128,
+        sliding_window=8,
+        rope_theta=10000.0,
+        tie_embeddings=False,
+        eos_token_id=1,
     )
     base.update(overrides)
     return ModelConfig(**base)

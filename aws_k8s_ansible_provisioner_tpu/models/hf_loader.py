@@ -299,6 +299,30 @@ def config_from_hf_dir(checkpoint_dir: str) -> ModelConfig:
             extra_eos_token_ids=tuple(eos_list[1:]),
             hf_repo=name,
         )
+    if model_type == "mistral":
+        eos = hf.get("eos_token_id") or 2
+        eos_list = eos if isinstance(eos, list) else [eos]
+        return ModelConfig(
+            name=name,
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads", 8),
+            head_dim=hf.get("head_dim") or
+            hf["hidden_size"] // hf["num_attention_heads"],
+            max_seq_len=hf.get("max_position_embeddings", 32768),
+            # v0.1 checkpoints declare 4096; v0.3+ set null (full attention)
+            sliding_window=int(hf.get("sliding_window") or 0),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            norm_eps=hf.get("rms_norm_eps", 1e-5),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            bos_token_id=hf.get("bos_token_id", 1),
+            eos_token_id=eos_list[0],
+            extra_eos_token_ids=tuple(eos_list[1:]),
+            hf_repo=name,
+        )
     if model_type == "gemma":
         return ModelConfig(
             name=name,

@@ -158,11 +158,14 @@ def repeat_kv(k: jnp.ndarray, num_heads: int) -> jnp.ndarray:
 
 
 def causal_attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                  seq_lens: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                  seq_lens: Optional[jnp.ndarray] = None,
+                  window: int = 0) -> jnp.ndarray:
     """Full causal self-attention over the current window.
 
     q: [B, T, Hq, D]; k/v: [B, T, Hkv, D]. ``seq_lens`` optionally masks padded
-    tail positions (right padding). float32 softmax.
+    tail positions (right padding); ``window`` > 0 additionally restricts each
+    query to its last ``window`` keys (sliding-window attention). float32
+    softmax.
     """
     B, T, Hq, D = q.shape
     k = repeat_kv(k, Hq)
@@ -172,6 +175,8 @@ def causal_attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         k.astype(jnp.float32)) * scale
     pos = jnp.arange(T)
     mask = pos[None, :] <= pos[:, None]  # [Tq, Tk] causal
+    if window > 0:
+        mask = mask & (pos[None, :] > pos[:, None] - window)
     if seq_lens is not None:
         valid = pos[None, :] < seq_lens[:, None]  # [B, Tk]
         mask = mask[None, :, :] & valid[:, None, :]
@@ -186,6 +191,17 @@ def causal_attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def _default_attend(q, k, v, cache):
     return causal_attend(q, k, v), cache
+
+
+def make_default_attend(cfg: ModelConfig):
+    """Full-window (training/parity) attend honoring cfg.sliding_window."""
+    if cfg.sliding_window <= 0:
+        return _default_attend
+
+    def attend(q, k, v, cache):
+        return causal_attend(q, k, v, window=cfg.sliding_window), cache
+
+    return attend
 
 
 # ---------------------------------------------------------------------------
@@ -365,7 +381,7 @@ def model_forward(
     remat: bool = False,
 ) -> Tuple[jnp.ndarray, Any]:
     """Run the decoder; returns (logits [B, T, V], updated cache)."""
-    attend = attend or _default_attend
+    attend = attend or make_default_attend(cfg)
     x, cos, sin = _embed_inputs(params, cfg, tokens, positions)
 
     def body(x, layer_in):

@@ -25,13 +25,14 @@ from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
 
 
 def decode_attend(q: jnp.ndarray, cache_k: jnp.ndarray, cache_v: jnp.ndarray,
-                  lengths: jnp.ndarray) -> jnp.ndarray:
+                  lengths: jnp.ndarray, window: int = 0) -> jnp.ndarray:
     """Cached decode attention for one new token per slot.
 
     q: [B, 1, Hq, D]; cache_k/v: [B, Hkv, S, D] head-major (already containing
     the new token's k/v at position lengths-1... i.e. caller writes first);
-    lengths: [B] = number of valid rows per slot (including the new token).
-    Returns [B, 1, Hq, D].
+    lengths: [B] = number of valid rows per slot (including the new token);
+    ``window`` > 0 = sliding-window attention (only the last ``window`` rows
+    are live). Returns [B, 1, Hq, D].
     """
     B, _, Hq, D = q.shape
     Hkv, S = cache_k.shape[1], cache_k.shape[2]
@@ -40,6 +41,9 @@ def decode_attend(q: jnp.ndarray, cache_k: jnp.ndarray, cache_v: jnp.ndarray,
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
     logits = jnp.einsum("bkgd,bksd->bkgs", qg, cache_k.astype(jnp.float32)) * scale
     valid = jnp.arange(S)[None, :] < lengths[:, None]          # [B, S]
+    if window > 0:
+        valid = valid & (jnp.arange(S)[None, :]
+                         >= lengths[:, None] - window)
     logits = jnp.where(valid[:, None, None, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     ctx = jnp.einsum("bkgs,bksd->bkgd", probs, cache_v.astype(jnp.float32))
@@ -64,7 +68,7 @@ def resolve_impl(impl: str = "auto") -> str:
 
 
 def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
-                             mesh=None):
+                             mesh=None, window: int = 0):
     """Carry-path decode attend: cache_l is ``(full_cache, layer_idx)``.
 
     Used with ``models.layers.model_forward_carry`` — the full stacked cache
@@ -87,6 +91,12 @@ def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
     """
     resolved = resolve_impl(impl)
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    if sp > 1 and window > 0:
+        # Enforced HERE, not only in Engine.__init__: the sp stats path below
+        # has no window support, and a direct caller must get an error — not
+        # silent full-attention results.
+        raise ValueError("sequence-parallel decode (sp > 1) does not compose "
+                         "with sliding-window attention")
 
     def _write_attend(q, cache, knew, vnew, lens, layer):
         """Per-shard body: in-place row writes + layer-indexed flash attend.
@@ -131,8 +141,12 @@ def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
             scale_kw = {}
         if sp == 1:
             ctx = pallas_attention.decode_attend_pallas_layer(
-                q, ck, cv, r_lens, layer, interpret=interpret, **scale_kw)
+                q, ck, cv, r_lens, layer, interpret=interpret,
+                window=window, **scale_kw)
             return ctx, cache
+        # sp > 1 with a sliding window is rejected at Engine init: the
+        # window straddles shard boundaries and the partial merge would
+        # need cross-shard start offsets.
         acc, m, l = pallas_attention.decode_attend_pallas_layer(
             q, ck, cv, r_lens, layer, interpret=interpret, return_stats=True,
             **scale_kw)
@@ -188,15 +202,15 @@ def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
                 # model dtype, not f32: attention upcasts internally anyway
                 ck = kvc.dequantize(ck, layer_slice("ks"), dtype=q.dtype)
                 cv = kvc.dequantize(cv, layer_slice("vs"), dtype=q.dtype)
-            ctx = decode_attend(q, ck, cv, lengths + 1)
+            ctx = decode_attend(q, ck, cv, lengths + 1, window=window)
         return ctx, (cache, layer)
 
     return attend
 
 
 def decode_attend_multi(q: jnp.ndarray, cache_k: jnp.ndarray,
-                        cache_v: jnp.ndarray, base_lens: jnp.ndarray
-                        ) -> jnp.ndarray:
+                        cache_v: jnp.ndarray, base_lens: jnp.ndarray,
+                        window: int = 0) -> jnp.ndarray:
     """XLA fallback for speculative verify: R query rows per slot.
 
     q: [B, R, Hq, D]; cache_k/v: [B, Hkv, S, D] (rows base..base+R-1 already
@@ -212,6 +226,9 @@ def decode_attend_multi(q: jnp.ndarray, cache_k: jnp.ndarray,
                         cache_k.astype(jnp.float32)) * scale
     limit = base_lens[:, None] + 1 + jnp.arange(R)[None, :]    # [B, R]
     valid = jnp.arange(S)[None, None, :] < limit[:, :, None]   # [B, R, S]
+    if window > 0:
+        valid = valid & (jnp.arange(S)[None, None, :]
+                         >= limit[:, :, None] - window)
     logits = jnp.where(valid[:, :, None, None, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     ctx = jnp.einsum("brkgs,bksd->brkgd", probs,
@@ -219,7 +236,8 @@ def decode_attend_multi(q: jnp.ndarray, cache_k: jnp.ndarray,
     return ctx.reshape(B, R, Hq, D).astype(q.dtype)
 
 
-def make_spec_attend_carry(lengths: jnp.ndarray, impl: str = "auto"):
+def make_spec_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
+                           window: int = 0):
     """Carry-path attend for SPECULATIVE verify: R tokens per slot per step.
 
     Same cache-in-scan-carry structure as make_decode_attend_carry, but the
@@ -265,7 +283,8 @@ def make_spec_attend_carry(lengths: jnp.ndarray, impl: str = "auto"):
                 cache = {"k": ck, "v": cv}
                 scale_kw = {}
             ctx = pallas_attention.decode_attend_pallas_spec(
-                q, ck, cv, lengths, layer, interpret=interpret, **scale_kw)
+                q, ck, cv, lengths, layer, interpret=interpret,
+                window=window, **scale_kw)
             return ctx, (cache, layer)
         # XLA fallback: scatter all R rows, then the multi-query masked attend
         for r in range(R):
@@ -280,13 +299,14 @@ def make_spec_attend_carry(lengths: jnp.ndarray, impl: str = "auto"):
         if kvc.is_quantized(cache):
             ck = kvc.dequantize(ck, layer_slice("ks"), dtype=q.dtype)
             cv = kvc.dequantize(cv, layer_slice("vs"), dtype=q.dtype)
-        ctx = decode_attend_multi(q, ck, cv, lengths)
+        ctx = decode_attend_multi(q, ck, cv, lengths, window=window)
         return ctx, (cache, layer)
 
     return attend
 
 
-def make_prefill_attend_batch(slots: jnp.ndarray, seq_lens: jnp.ndarray):
+def make_prefill_attend_batch(slots: jnp.ndarray, seq_lens: jnp.ndarray,
+                              window: int = 0):
     """Attend callback for BATCHED prefill: N prompts into N slots at once.
 
     One dispatch prefills up to ``max_prefill_batch`` queued prompts — under a
@@ -298,7 +318,7 @@ def make_prefill_attend_batch(slots: jnp.ndarray, seq_lens: jnp.ndarray):
     from aws_k8s_ansible_provisioner_tpu.models.layers import causal_attend
 
     def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
-        ctx = causal_attend(q, k, v, seq_lens=seq_lens)
+        ctx = causal_attend(q, k, v, seq_lens=seq_lens, window=window)
         cache_l = kvc.write_prompts(cache_l, slots, k, v)
         return ctx, cache_l
 
@@ -306,7 +326,7 @@ def make_prefill_attend_batch(slots: jnp.ndarray, seq_lens: jnp.ndarray):
 
 
 def chunk_attend(q: jnp.ndarray, ck: jnp.ndarray, cv: jnp.ndarray,
-                 start: jnp.ndarray) -> jnp.ndarray:
+                 start: jnp.ndarray, window: int = 0) -> jnp.ndarray:
     """Attention for one prefill chunk against the slot's cache prefix.
 
     q: [1, C, Hq, D] (chunk queries, already rotary-encoded at positions
@@ -325,13 +345,16 @@ def chunk_attend(q: jnp.ndarray, ck: jnp.ndarray, cv: jnp.ndarray,
     cols = jnp.arange(S)[None, :]                     # [1, S]
     rows = start + jnp.arange(C)[:, None]             # [C, 1]
     mask = cols <= rows                               # [C, S]
+    if window > 0:
+        mask = mask & (cols > rows - window)
     logits = jnp.where(mask[:, None, None, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     ctx = jnp.einsum("ckgs,ksd->ckgd", probs, cv.astype(jnp.float32))
     return ctx.reshape(C, Hq, D)[None].astype(q.dtype)
 
 
-def make_chunk_prefill_attend(slot: jnp.ndarray, start: jnp.ndarray):
+def make_chunk_prefill_attend(slot: jnp.ndarray, start: jnp.ndarray,
+                              window: int = 0):
     """Attend callback for CHUNKED prefill: one chunk of a long prompt.
 
     Writes the chunk's K/V rows into the slot, then attends the chunk queries
@@ -350,13 +373,14 @@ def make_chunk_prefill_attend(slot: jnp.ndarray, start: jnp.ndarray):
             # decode hot loop never does this; its kernels fold the scales).
             ck = kvc.dequantize(ck, cache_l["ks"][slot], dtype=q.dtype)
             cv = kvc.dequantize(cv, cache_l["vs"][slot], dtype=q.dtype)
-        ctx = chunk_attend(q, ck, cv, start)
+        ctx = chunk_attend(q, ck, cv, start, window=window)
         return ctx, cache_l
 
     return attend
 
 
-def make_prefill_attend(slot: jnp.ndarray, seq_len: jnp.ndarray):
+def make_prefill_attend(slot: jnp.ndarray, seq_len: jnp.ndarray,
+                        window: int = 0):
     """Attend callback for single-sequence prefill into one cache slot.
 
     Causal attention over the (padded) prompt window + write of k/v rows into the
@@ -365,7 +389,7 @@ def make_prefill_attend(slot: jnp.ndarray, seq_len: jnp.ndarray):
     from aws_k8s_ansible_provisioner_tpu.models.layers import causal_attend
 
     def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
-        ctx = causal_attend(q, k, v, seq_lens=seq_len[None])
+        ctx = causal_attend(q, k, v, seq_lens=seq_len[None], window=window)
         cache_l = kvc.write_prompt(cache_l, slot, k, v)
         return ctx, cache_l
 

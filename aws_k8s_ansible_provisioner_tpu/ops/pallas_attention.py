@@ -83,18 +83,22 @@ def _decode_kernel_layer(lengths_ref,      # scalar prefetch [B] int32
                          v_ref,            # [1, 1, Hkv, CHUNK, D]
                          o_ref,            # [1, Hq, D]
                          acc_ref, m_ref, l_ref,
-                         *, chunk: int, groups: int, scale: float):
+                         *, chunk: int, groups: int, scale: float,
+                         window: int = 0):
     """Same flash accumulation as ``_decode_kernel`` but over the FULL
     [L, B, Hkv, S, D] cache: the layer index arrives as a scalar-prefetch value
     and the index_map selects the layer block, so the carry-path decode
     (models/layers.model_forward_carry) never materializes a per-layer cache
-    slice in HBM."""
+    slice in HBM. ``window`` > 0 = sliding-window attention: only the last
+    ``window`` columns are live; chunks entirely below it are skipped (their
+    DMA was already clamped away by the index map)."""
     b = pl.program_id(0)
     c = pl.program_id(1)
     num_chunks = pl.num_programs(1)
     length = lengths_ref[b]
     hq, d = q_ref.shape[1], q_ref.shape[2]
     hkv = k_ref.shape[2]
+    lo = jnp.maximum(length - window, 0) if window > 0 else 0
 
     @pl.when(c == 0)
     def _init():
@@ -102,7 +106,7 @@ def _decode_kernel_layer(lengths_ref,      # scalar prefetch [B] int32
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    @pl.when(c * chunk < length)
+    @pl.when((c * chunk < length) & ((c + 1) * chunk > lo))
     def _accumulate():
         q3 = (q_ref[0].astype(jnp.float32) * scale).reshape(hkv, groups, d)
         k3 = k_ref[0, 0].astype(jnp.float32)                      # [Hkv, C, D]
@@ -111,7 +115,7 @@ def _decode_kernel_layer(lengths_ref,      # scalar prefetch [B] int32
             preferred_element_type=jnp.float32)                   # [Hkv, G, C]
         s = s.reshape(hq, chunk)
         col = c * chunk + jax.lax.broadcasted_iota(jnp.int32, (hq, chunk), 1)
-        s = jnp.where(col < length, s, NEG_INF)
+        s = jnp.where((col < length) & (col >= lo), s, NEG_INF)
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -141,7 +145,8 @@ def _decode_kernel_layer_q(lengths_ref,     # scalar prefetch [B] int32
                            ks_ref,          # [1, 1, Hkv, CHUNK] f32 scales
                            vs_ref,          # [1, 1, Hkv, CHUNK] f32 scales
                            o_ref, acc_ref, m_ref, l_ref,
-                           *, chunk: int, groups: int, scale: float):
+                           *, chunk: int, groups: int, scale: float,
+                           window: int = 0):
     """Int8-cache variant of ``_decode_kernel_layer``: K/V stream as int8 (half
     the HBM traffic of bf16 — the whole point; decode is cache-bandwidth-bound)
     and dequantization folds into the flash accumulation inside VMEM:
@@ -154,6 +159,7 @@ def _decode_kernel_layer_q(lengths_ref,     # scalar prefetch [B] int32
     length = lengths_ref[b]
     hq, d = q_ref.shape[1], q_ref.shape[2]
     hkv = k_ref.shape[2]
+    lo = jnp.maximum(length - window, 0) if window > 0 else 0
 
     @pl.when(c == 0)
     def _init():
@@ -161,7 +167,7 @@ def _decode_kernel_layer_q(lengths_ref,     # scalar prefetch [B] int32
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    @pl.when(c * chunk < length)
+    @pl.when((c * chunk < length) & ((c + 1) * chunk > lo))
     def _accumulate():
         q3 = (q_ref[0].astype(jnp.float32) * scale).reshape(hkv, groups, d)
         k3 = k_ref[0, 0].astype(jnp.float32)                  # [Hkv, C, D]
@@ -171,7 +177,7 @@ def _decode_kernel_layer_q(lengths_ref,     # scalar prefetch [B] int32
         s = s * ks_ref[0, 0][:, None, :]                      # fold k scales
         s = s.reshape(hq, chunk)
         col = c * chunk + jax.lax.broadcasted_iota(jnp.int32, (hq, chunk), 1)
-        s = jnp.where(col < length, s, NEG_INF)
+        s = jnp.where((col < length) & (col >= lo), s, NEG_INF)
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -196,11 +202,13 @@ def _decode_kernel_layer_q(lengths_ref,     # scalar prefetch [B] int32
 def _decode_kernel_layer_q_stats(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
                                  ks_ref, vs_ref, o_ref, mo_ref, lo_ref,
                                  acc_ref, m_ref, l_ref,
-                                 *, chunk: int, groups: int, scale: float):
+                                 *, chunk: int, groups: int, scale: float,
+                                 window: int = 0):
     """Stats-emitting int8 variant (sequence-parallel decode merge)."""
     _decode_kernel_layer_q(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
                            ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref,
-                           chunk=chunk, groups=groups, scale=scale)
+                           chunk=chunk, groups=groups, scale=scale,
+                           window=window)
     c = pl.program_id(1)
 
     @pl.when(c == pl.num_programs(1) - 1)
@@ -215,7 +223,8 @@ def _decode_kernel_layer_stats(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
                                mo_ref,      # [1, Hq, 128] f32 running max
                                lo_ref,      # [1, Hq, 128] f32 running denom
                                acc_ref, m_ref, l_ref,
-                               *, chunk: int, groups: int, scale: float):
+                               *, chunk: int, groups: int, scale: float,
+                               window: int = 0):
     """Stats-emitting variant for sequence-parallel decode: instead of the
     normalized context, outputs the raw flash triple (acc, m, l) so the
     caller can merge partials across sequence shards with a log-sum-exp
@@ -223,7 +232,8 @@ def _decode_kernel_layer_stats(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
     emits (0, -inf, 0), which contributes nothing to the merge."""
     _decode_kernel_layer(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
                          o_ref, acc_ref, m_ref, l_ref,
-                         chunk=chunk, groups=groups, scale=scale)
+                         chunk=chunk, groups=groups, scale=scale,
+                         window=window)
     c = pl.program_id(1)
 
     @pl.when(c == pl.num_programs(1) - 1)
@@ -234,14 +244,16 @@ def _decode_kernel_layer_stats(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("chunk", "interpret", "return_stats"))
+                   static_argnames=("chunk", "interpret", "return_stats",
+                                    "window"))
 def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
                                cache_v: jnp.ndarray, lengths: jnp.ndarray,
                                layer: jnp.ndarray, chunk: int = 256,
                                interpret: bool = False,
                                return_stats: bool = False,
                                cache_ks: jnp.ndarray = None,
-                               cache_vs: jnp.ndarray = None):
+                               cache_vs: jnp.ndarray = None,
+                               window: int = 0):
     """Flash decode attention over ONE layer of the full stacked cache.
 
     q: [B, 1, Hq, D]; cache_k/v: [L, B, Hkv, S, D] (the whole cache buffer —
@@ -270,13 +282,21 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
     def q_map(b, c, lens, lay):
         return (b, 0, 0)
 
+    def _clamped(b, c, lens):
+        # live chunk range [lo, hi]: above the slot's length AND (with a
+        # sliding window) below its window start, chunks clamp to the range
+        # edge — Pallas skips the repeated fetch, so dead cache never moves
+        hi = jnp.maximum(pl.cdiv(lens[b], chunk) - 1, 0)
+        if window > 0:
+            lo_chunk = jnp.maximum(lens[b] - window, 0) // chunk
+            return jnp.clip(c, lo_chunk, hi)
+        return jnp.minimum(c, hi)
+
     def kv_map(b, c, lens, lay):
-        live = jnp.maximum(pl.cdiv(lens[b], chunk) - 1, 0)
-        return (lay[0], b, 0, jnp.minimum(c, live), 0)
+        return (lay[0], b, 0, _clamped(b, c, lens), 0)
 
     def scale_map(b, c, lens, lay):
-        live = jnp.maximum(pl.cdiv(lens[b], chunk) - 1, 0)
-        return (lay[0], b, 0, jnp.minimum(c, live))
+        return (lay[0], b, 0, _clamped(b, c, lens))
 
     scratch = [
         pltpu.VMEM((Hq, D), jnp.float32),
@@ -310,7 +330,7 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
         kernel = functools.partial(
             _decode_kernel_layer_q_stats if quant
             else _decode_kernel_layer_stats,
-            chunk=chunk, groups=groups, scale=scale)
+            chunk=chunk, groups=groups, scale=scale, window=window)
         acc, m, l = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
@@ -332,7 +352,7 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
     )
     kernel = functools.partial(
         _decode_kernel_layer_q if quant else _decode_kernel_layer,
-        chunk=chunk, groups=groups, scale=scale)
+        chunk=chunk, groups=groups, scale=scale, window=window)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -344,7 +364,8 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
 
 def _spec_accumulate(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
                      o_ref, acc_ref, m_ref, l_ref,
-                     *, chunk: int, groups: int, scale: float, R: int):
+                     *, chunk: int, groups: int, scale: float, R: int,
+                     window: int = 0):
     """Shared body for the R-draft speculative decode kernels.
 
     q_ref: [1, R*Hq, D] — R query rows per slot (the last accepted token plus
@@ -361,6 +382,8 @@ def _spec_accumulate(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
     d = q_ref.shape[2]
     hkv = k_ref.shape[2]
     hq = q_ref.shape[1] // R
+    # below-window compute skip (row 0's window start bounds all R rows)
+    lo = jnp.maximum(length + 1 - window, 0) if window > 0 else 0
 
     @pl.when(c == 0)
     def _init():
@@ -368,7 +391,7 @@ def _spec_accumulate(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    @pl.when(c * chunk < length + R)
+    @pl.when((c * chunk < length + R) & ((c + 1) * chunk > lo))
     def _accumulate():
         k3 = k_ref[0, 0].astype(jnp.float32)                  # [Hkv, C, D]
         v3 = v_ref[0, 0].astype(jnp.float32)
@@ -384,7 +407,10 @@ def _spec_accumulate(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
             s = s.reshape(hq, chunk)
             col = c * chunk + jax.lax.broadcasted_iota(
                 jnp.int32, (hq, chunk), 1)
-            s = jnp.where(col < length + 1 + r, s, NEG_INF)
+            live = col < length + 1 + r
+            if window > 0:   # sliding window: row r sees its last W keys
+                live = live & (col >= length + 1 + r - window)
+            s = jnp.where(live, s, NEG_INF)
             m_prev = m_ref[sl, :1]
             l_prev = l_ref[sl, :1]
             m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -419,13 +445,14 @@ def _spec_kernel_quant(lengths_ref, layer_ref, q_ref, k_ref, v_ref, ks_ref,
                      o_ref, acc_ref, m_ref, l_ref, **kw)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk", "interpret"))
+@functools.partial(jax.jit, static_argnames=("chunk", "interpret", "window"))
 def decode_attend_pallas_spec(q: jnp.ndarray, cache_k: jnp.ndarray,
                               cache_v: jnp.ndarray, lengths: jnp.ndarray,
                               layer: jnp.ndarray, chunk: int = 256,
                               interpret: bool = False,
                               cache_ks: jnp.ndarray = None,
-                              cache_vs: jnp.ndarray = None) -> jnp.ndarray:
+                              cache_vs: jnp.ndarray = None,
+                              window: int = 0) -> jnp.ndarray:
     """Speculative-verify flash attention: R query rows per slot in one pass.
 
     q: [B, R, Hq, D] — row r is the query at position lengths[b] + r (the
@@ -447,13 +474,19 @@ def decode_attend_pallas_spec(q: jnp.ndarray, cache_k: jnp.ndarray,
     def q_map(b, c, lens, lay):
         return (b, 0, 0)
 
+    def _clamped(b, c, lens):
+        hi = jnp.maximum(pl.cdiv(lens[b] + R, chunk) - 1, 0)
+        if window > 0:
+            # lowest chunk any of the R rows can see (row 0's window start)
+            lo_chunk = jnp.maximum(lens[b] + 1 - window, 0) // chunk
+            return jnp.clip(c, lo_chunk, hi)
+        return jnp.minimum(c, hi)
+
     def kv_map(b, c, lens, lay):
-        live = jnp.maximum(pl.cdiv(lens[b] + R, chunk) - 1, 0)
-        return (lay[0], b, 0, jnp.minimum(c, live), 0)
+        return (lay[0], b, 0, _clamped(b, c, lens), 0)
 
     def scale_map(b, c, lens, lay):
-        live = jnp.maximum(pl.cdiv(lens[b] + R, chunk) - 1, 0)
-        return (lay[0], b, 0, jnp.minimum(c, live))
+        return (lay[0], b, 0, _clamped(b, c, lens))
 
     in_specs = [
         pl.BlockSpec((1, R * Hq, D), q_map),
@@ -477,7 +510,8 @@ def decode_attend_pallas_spec(q: jnp.ndarray, cache_k: jnp.ndarray,
     )
     kernel = functools.partial(
         _spec_kernel_quant if quant else _spec_kernel_plain,
-        chunk=chunk, groups=groups, scale=1.0 / (D ** 0.5), R=R)
+        chunk=chunk, groups=groups, scale=1.0 / (D ** 0.5), R=R,
+        window=window)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,

@@ -50,8 +50,8 @@ from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
 from aws_k8s_ansible_provisioner_tpu.models.layers import (
     _embed_inputs,
     _final_logits,
-    causal_attend,
     decoder_block,
+    make_default_attend,
 )
 
 
@@ -98,12 +98,14 @@ def make_pipeline_lm_loss(cfg: ModelConfig, mesh: Mesh, n_microbatches: int,
     M = n_microbatches
     has_dp = "dp" in mesh.axis_names
 
+    # honors cfg.sliding_window — the pipelined loss must match
+    # model_forward's mask exactly (the parity tests' whole point)
+    attend = make_default_attend(cfg)
+
     def stage_fwd(p_stage, x, cos, sin):
         """Run this device's layer block over activation x [mb, T, H]."""
         def body(x, p_l):
-            x, _ = decoder_block(cfg, p_l, x, cos, sin,
-                                 lambda q, k, v, c: (causal_attend(q, k, v), c),
-                                 None)
+            x, _ = decoder_block(cfg, p_l, x, cos, sin, attend, None)
             return x, None
         if remat:
             body = jax.checkpoint(body)

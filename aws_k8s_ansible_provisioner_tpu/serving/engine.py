@@ -121,7 +121,8 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
     """
     T = tokens.shape[1]
     positions = jnp.arange(T, dtype=jnp.int32)[None, :]
-    attend = make_prefill_attend(slot, true_len)
+    attend = make_prefill_attend(slot, true_len,
+                                 window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = jnp.take(logits[0], true_len - 1, axis=0)       # [V]
     token = sample(last[None, :], rng, temperature[None], top_k[None],
@@ -143,7 +144,8 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
     """
     N, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (N, T))
-    attend = make_prefill_attend_batch(slots, true_lens)
+    attend = make_prefill_attend_batch(slots, true_lens,
+                                       window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = logits[jnp.arange(N), true_lens - 1]            # [N, V]
     toks = sample(last, rng, temperature, top_k, top_p)
@@ -165,7 +167,8 @@ def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
     """
     C = tokens.shape[1]
     positions = start + jnp.arange(C, dtype=jnp.int32)[None, :]
-    attend = make_chunk_prefill_attend(slot, start)
+    attend = make_chunk_prefill_attend(slot, start,
+                                       window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = jnp.take(logits[0], chunk_len - 1, axis=0)      # [V]
     token = sample(last[None, :], rng, temperature[None], top_k[None],
@@ -200,7 +203,8 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
         # attention reads it layer-indexed — no per-layer xs→ys copy (the
         # copy cost dominated decode at ~24 ms/token on v5e; see
         # model_forward_carry's docstring).
-        attend = make_decode_attend_carry(lens, impl=impl, mesh=mesh)
+        attend = make_decode_attend_carry(lens, impl=impl, mesh=mesh,
+                                          window=cfg.sliding_window)
         logits, cache = model_forward_carry(params, cfg, tok[:, None],
                                             positions, cache, attend)
         nxt = sample(logits[:, 0, :], rng_i, temperature, top_k, top_p)
@@ -234,7 +238,8 @@ def spec_decode_step(cfg: ModelConfig, R: int, params, cache, tokens,
     """
     B = tokens.shape[0]
     positions = lengths[:, None] + jnp.arange(R, dtype=jnp.int32)[None, :]
-    attend = make_spec_attend_carry(lengths, impl=impl)
+    attend = make_spec_attend_carry(lengths, impl=impl,
+                                    window=cfg.sliding_window)
     logits, cache = model_forward_carry(params, cfg, tokens, positions,
                                         cache, attend)
     preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # [B, R]
@@ -329,6 +334,12 @@ class Engine:
             if self.num_slots % dp:
                 raise ValueError(f"max_decode_slots={self.num_slots} must be "
                                  f"divisible by dp={dp}")
+            if sp > 1 and cfg.sliding_window > 0:
+                raise ValueError(
+                    "sequence-parallel serving (sp > 1) does not compose "
+                    "with sliding-window attention: the window straddles "
+                    "shard boundaries (shard by dp/tp instead, or serve "
+                    "the model with full attention)")
             if sp > 1 and self.max_len % (sp * 8):
                 raise ValueError(
                     f"cache window {self.max_len} must split into 8-row-"

@@ -410,6 +410,13 @@ def build_state(serving_cfg=None, model_cfg=None, params=None,
             model_cfg = tiny_gemma(vocab_size=tokenizer.vocab_size,
                                    eos_token_id=tokenizer.eos_token_id,
                                    num_layers=4, hidden_size=128)
+        elif serving.model == "tiny-mistral":
+            from aws_k8s_ansible_provisioner_tpu.config import tiny_mistral
+
+            model_cfg = tiny_mistral(vocab_size=tokenizer.vocab_size,
+                                     eos_token_id=tokenizer.eos_token_id,
+                                     num_layers=4, hidden_size=128,
+                                     sliding_window=32)
         else:
             raise ValueError(f"unknown model {serving.model!r} and no checkpoint")
 

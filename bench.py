@@ -143,9 +143,11 @@ def measure() -> None:
         # network-attached under the bench harness, ~100 ms RTT/dispatch);
         # serving keeps the smaller default so streaming latency stays bounded.
         decode_horizon=int(env("TPU_BENCH_HORIZON", 96 if on_tpu else 4)),
-        # Prefilling 16 queued prompts per dispatch keeps the burst TTFT
-        # dispatch-count low (8 dispatches for the 128-slot fill).
-        max_prefill_batch=16 if on_tpu else 4,
+        # Prefilling 32 queued prompts per dispatch keeps the burst TTFT
+        # dispatch-count low (4 dispatches for the 128-slot fill): measured
+        # TTFT p50 860 -> 554 ms vs 16/dispatch at identical throughput.
+        max_prefill_batch=int(env("TPU_BENCH_PREFILL_BATCH",
+                                  32 if on_tpu else 4)),
         kv_dtype=kv_dtype,
     )
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.bfloat16)

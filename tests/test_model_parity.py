@@ -124,6 +124,30 @@ def _hf_gemma(cfg):
     return GemmaForCausalLM(hf_cfg).eval()
 
 
+def _hf_mistral(cfg):
+    import torch
+    from transformers import MistralConfig
+    from transformers.models.mistral.modeling_mistral import MistralForCausalLM
+
+    hf_cfg = MistralConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        max_position_embeddings=cfg.max_seq_len,
+        rms_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rope_theta,
+        sliding_window=cfg.sliding_window,   # 8 < T: the window mask matters
+        tie_word_embeddings=cfg.tie_embeddings,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    return MistralForCausalLM(hf_cfg).eval()
+
+
 def _hf_opt(cfg):
     import torch
     from transformers import OPTConfig
@@ -148,11 +172,11 @@ def _hf_opt(cfg):
 
 
 @pytest.mark.parametrize("family", ["qwen3", "phi", "opt", "llama",
-                                    "llama_unscaled", "gemma"])
+                                    "llama_unscaled", "gemma", "mistral"])
 def test_logits_match_hf(family):
     import torch
 
-    from aws_k8s_ansible_provisioner_tpu.config import tiny_gemma
+    from aws_k8s_ansible_provisioner_tpu.config import tiny_gemma, tiny_mistral
 
     builders = {"qwen3": (tiny_qwen3, _hf_qwen3), "phi": (tiny_phi, _hf_phi),
                 "opt": (tiny_opt, _hf_opt),
@@ -164,7 +188,10 @@ def test_logits_match_hf(family):
                                        tie_embeddings=False),
                     _hf_llama),
                 # zero-centered norms + scaled embed + GeGLU + MQA
-                "gemma": (tiny_gemma, _hf_gemma)}
+                "gemma": (tiny_gemma, _hf_gemma),
+                # sliding-window attention (window 8 < the 17-token test
+                # sequence, so the mask is load-bearing for parity)
+                "mistral": (tiny_mistral, _hf_mistral)}
     mk_cfg, mk_model = builders[family]
     cfg = mk_cfg()
     model = mk_model(cfg)
