@@ -85,9 +85,16 @@ class Request:
     ignore_eos: bool = False
     stream: bool = False
     cancelled: bool = False
+    # OpenAI ``logprobs``: None = off; an int N = return the chosen token's
+    # logprob plus N top alternatives (N=0 is valid: chosen-only, the OpenAI
+    # completions logprobs=0 semantics; capped at LOGPROB_K). Any non-None
+    # value switches the slot's dispatches to the logprob program variants.
+    logprobs: object = None
     id: int = field(default_factory=lambda: next(_REQUEST_IDS))
     # Filled in by the engine:
     generated: List[int] = field(default_factory=list)
+    # per generated token: (own logprob, [(token_id, logprob) x k])
+    logprob_data: List[tuple] = field(default_factory=list)
     out_queue: "queue.Queue" = field(default_factory=queue.Queue)
     t_submit: float = 0.0
     t_first_token: float = 0.0
@@ -111,9 +118,38 @@ class Request:
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+# Static top-k width for OpenAI ``logprobs`` responses (vLLM caps similarly);
+# per-request k <= this is sliced on the host.
+LOGPROB_K = 8
+
+
+def _logprob_topk(logits: jnp.ndarray, chosen: jnp.ndarray):
+    """(chosen logprob [B], top-k logprobs [B, K], top-k ids [B, K]) from
+    raw logits [B, V] — the OpenAI ``logprobs`` payload, computed on-device
+    only in the logprob program variants (log_softmax + top_k over a 152k
+    vocab is real VPU work the default hot path must not pay)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    sel = jnp.take_along_axis(logp, chosen[:, None].astype(jnp.int32),
+                              axis=1)[:, 0]
+    vals, ids = jax.lax.top_k(logp, min(LOGPROB_K, logp.shape[-1]))
+    return sel, vals, ids.astype(jnp.int32)
+
+
+def _host_lp(lp_t, row: int, k: int):
+    """Slice one row of a device (sel, vals, ids) triple into the host-side
+    per-token logprob record: (own_logprob, [(token_id, logprob) x k])."""
+    sel, vals, ids = lp_t
+    sel = float(np.asarray(sel[row]))
+    vals = np.asarray(vals[row])
+    ids = np.asarray(ids[row])
+    k = min(k, len(ids))
+    return (sel, [(int(ids[j]), float(vals[j])) for j in range(k)])
+
+
+@partial(jax.jit, static_argnums=(0,), static_argnames=("logprobs",),
+         donate_argnums=(2,))
 def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
-                 temperature, top_k, top_p):
+                 temperature, top_k, top_p, logprobs: bool = False):
     """Prefill one prompt into one slot; returns (cache, first sampled token).
 
     tokens: [1, T] right-padded to a bucket; true_len: scalar valid length;
@@ -127,12 +163,16 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
     last = jnp.take(logits[0], true_len - 1, axis=0)       # [V]
     token = sample(last[None, :], rng, temperature[None], top_k[None],
                    top_p[None])[0]
+    if logprobs:
+        return cache, token, _logprob_topk(last[None, :], token[None])
     return cache, token
 
 
-@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+@partial(jax.jit, static_argnums=(0,), static_argnames=("logprobs",),
+         donate_argnums=(2,))
 def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
-                       slots, rng, temperature, top_k, top_p):
+                       slots, rng, temperature, top_k, top_p,
+                       logprobs: bool = False):
     """Prefill N prompts into N slots in ONE dispatch.
 
     tokens: [N, T] right-padded to a (row, length) bucket; true_lens/slots/
@@ -149,12 +189,16 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = logits[jnp.arange(N), true_lens - 1]            # [N, V]
     toks = sample(last, rng, temperature, top_k, top_p)
+    if logprobs:
+        return cache, toks, _logprob_topk(last, toks)
     return cache, toks
 
 
-@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+@partial(jax.jit, static_argnums=(0,), static_argnames=("logprobs",),
+         donate_argnums=(2,))
 def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
-                       chunk_len, rng, temperature, top_k, top_p):
+                       chunk_len, rng, temperature, top_k, top_p,
+                       logprobs: bool = False):
     """Prefill ONE chunk of a long prompt; decode interleaves between chunks.
 
     tokens: [1, C] (the chunk, right-padded on the final chunk); start: row
@@ -173,14 +217,17 @@ def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
     last = jnp.take(logits[0], chunk_len - 1, axis=0)      # [V]
     token = sample(last[None, :], rng, temperature[None], top_k[None],
                    top_p[None])[0]
+    if logprobs:
+        return cache, token, _logprob_topk(last[None, :], token[None])
     return cache, token
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "impl"),
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "impl",
+                                                          "logprobs"),
          donate_argnums=(3,))
 def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
                  lengths, rng, temperature, top_k, top_p, mesh=None,
-                 impl: str = "auto"):
+                 impl: str = "auto", logprobs: bool = False):
     """``n_steps`` fused decode steps for every slot, one device dispatch.
 
     tokens/lengths/sampling params: [B]. Returns (cache, out [n_steps, B]).
@@ -208,6 +255,9 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
         logits, cache = model_forward_carry(params, cfg, tok[:, None],
                                             positions, cache, attend)
         nxt = sample(logits[:, 0, :], rng_i, temperature, top_k, top_p)
+        if logprobs:
+            return (cache, nxt, lens + 1), (nxt,
+                                            _logprob_topk(logits[:, 0], nxt))
         return (cache, nxt, lens + 1), nxt
 
     rngs = jax.random.split(rng, n_steps)
@@ -527,6 +577,9 @@ class Engine:
 
     # -- scheduling ---------------------------------------------------------
 
+    def _want_logprobs(self, reqs) -> bool:
+        return any(r is not None and r.logprobs is not None for r in reqs)
+
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self.slot_req) if r is None]
 
@@ -660,7 +713,7 @@ class Engine:
             return True
         return False
 
-    def _activate(self, req: Request, slot: int, token: int):
+    def _activate(self, req: Request, slot: int, token: int, lp=None):
         """Shared post-prefill bookkeeping: slot state + TTFT + first token."""
         now = time.monotonic()
         req.t_first_token = now
@@ -674,7 +727,7 @@ class Engine:
         self.top_ps[slot] = req.top_p
         self.sched.note_prefill(slot, len(req.prompt_ids))
         self.metrics.active_requests.set(len(self._active_slots()))
-        self._emit(slot, token)
+        self._emit(slot, token, lp)
 
     def _do_prefill(self, req: Request, slot: int):
         self._slot_tokens[slot] = ()   # rows about to be overwritten
@@ -683,14 +736,21 @@ class Engine:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :len(ids)] = ids
         t0 = time.monotonic()
-        self.cache, token = prefill_step(
+        out = prefill_step(
             self.cfg, self.params, self.cache,
             jnp.asarray(tokens), jnp.int32(len(ids)), jnp.int32(slot),
             self._next_rng(), jnp.float32(req.temperature),
-            jnp.int32(req.top_k), jnp.float32(req.top_p))
+            jnp.int32(req.top_k), jnp.float32(req.top_p),
+            logprobs=req.logprobs is not None)
+        lp = None
+        if req.logprobs is not None:
+            self.cache, token, lp_t = out
+            lp = _host_lp(lp_t, 0, req.logprobs)
+        else:
+            self.cache, token = out
         token = int(token)  # device sync
         self.metrics.device_busy_seconds.inc(time.monotonic() - t0)
-        self._activate(req, slot, token)
+        self._activate(req, slot, token, lp)
 
     def _do_prefill_batch(self, batch: List):
         """Prefill N waiting prompts in one dispatch (rows padded to a power
@@ -716,14 +776,23 @@ class Engine:
             top_ks[i] = req.top_k
             top_ps[i] = req.top_p
         t0 = time.monotonic()
-        self.cache, toks = prefill_batch_step(
+        want_lp = self._want_logprobs([r for r, _ in batch])
+        out = prefill_batch_step(
             self.cfg, self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(true_lens), jnp.asarray(slots), self._next_rng(),
-            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps))
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            logprobs=want_lp)
+        lp_t = None
+        if want_lp:
+            self.cache, toks, lp_t = out
+        else:
+            self.cache, toks = out
         toks = np.asarray(toks)  # device sync
         self.metrics.device_busy_seconds.inc(time.monotonic() - t0)
         for i, (req, slot) in enumerate(batch):
-            self._activate(req, slot, int(toks[i]))
+            lp = _host_lp(lp_t, i, req.logprobs) \
+                if req.logprobs is not None else None
+            self._activate(req, slot, int(toks[i]), lp)
 
     def _start_chunk(self, req: Request, slot: int, pref):
         """Begin chunked prefill of ``req`` into ``slot``; with a prefix-cache
@@ -767,12 +836,19 @@ class Engine:
         tokens = np.zeros((1, C), np.int32)
         tokens[0, :len(chunk)] = chunk
         t0 = time.monotonic()
+        lp_t = None
         try:
-            self.cache, token = prefill_chunk_step(
+            out = prefill_chunk_step(
                 self.cfg, self.params, self.cache, jnp.asarray(tokens),
                 jnp.int32(off), jnp.int32(slot), jnp.int32(len(chunk)),
                 self._next_rng(), jnp.float32(req.temperature),
-                jnp.int32(req.top_k), jnp.float32(req.top_p))
+                jnp.int32(req.top_k), jnp.float32(req.top_p),
+                logprobs=(req.logprobs is not None
+                          and off + len(chunk) >= len(ids)))
+            if req.logprobs is not None and off + len(chunk) >= len(ids):
+                self.cache, token, lp_t = out
+            else:
+                self.cache, token = out
         except Exception:
             self._chunk = None
             self.sched.release(slot)
@@ -788,7 +864,9 @@ class Engine:
         self.lengths[slot] = st["off"]
         if st["off"] >= len(ids):
             self._chunk = None
-            self._activate(req, slot, int(token))
+            lp = _host_lp(lp_t, 0, req.logprobs) \
+                if req.logprobs is not None else None
+            self._activate(req, slot, int(token), lp)
 
     def _propose_drafts(self, active: List[int]):
         """Prompt-lookup drafts per active slot: match the context's trailing
@@ -877,18 +955,28 @@ class Engine:
         # stands) and single-device (accept lengths are data-dependent per
         # slot; a dp mesh would desync). Falls back when no context matched.
         if (self.serving.spec_decode and self.mesh is None and horizon > 1
+                and not self._want_logprobs(self.slot_req)
                 and self.lengths[active].max(initial=0) + self.serving.spec_k
                 + 1 < self.max_len):
             proposal = self._propose_drafts(active)
             if proposal is not None:
                 self._do_spec_decode(active, *proposal)
                 return
+        want_lp = self._want_logprobs(self.slot_req)
         self.cache, out = decode_steps(
             self.cfg, horizon, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
             self._next_rng(), jnp.asarray(self.temps),
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
-            mesh=self.mesh, impl=self.serving.attention_impl)
+            mesh=self.mesh, impl=self.serving.attention_impl,
+            logprobs=want_lp)
+        lp_t = None
+        if want_lp:
+            out, lp_t = out          # ([h, B], ([h,B], [h,B,K], [h,B,K]))
+            # ONE bulk transfer; per-token slicing below is pure numpy (3
+            # tiny device gathers per emitted token would round-trip the
+            # network-attached chip thousands of times per dispatch)
+            lp_t = tuple(np.asarray(a) for a in lp_t)
         out = np.asarray(out)  # [horizon, B]
         dt = time.monotonic() - t0
         self.metrics.decode_step_duration.observe(dt / horizon)
@@ -898,9 +986,14 @@ class Engine:
             for slot in active:
                 if self.slot_req[slot] is None:
                     continue  # finished earlier in this horizon
+                req = self.slot_req[slot]
+                lp = None
+                if req.logprobs is not None and lp_t is not None:
+                    lp = _host_lp(tuple(a[s] for a in lp_t), slot,
+                                  req.logprobs)
                 self.lengths[slot] += 1
                 self.sched.note_decode(slot, 1)
-                self._emit(slot, int(out[s, slot]))
+                self._emit(slot, int(out[s, slot]), lp)
                 emitted += 1
         self._tok_times.append((t0, emitted))
         if len(self._tok_times) >= 2:
@@ -909,10 +1002,14 @@ class Engine:
             if span > 0:
                 self.metrics.tokens_per_second.set(toks / span)
 
-    def _emit(self, slot: int, token: int):
+    def _emit(self, slot: int, token: int, lp=None):
         """Record one generated token for a slot; handle stop conditions."""
         req = self.slot_req[slot]
         req.generated.append(token)
+        if req.logprobs is not None:
+            # pad with None if a path couldn't supply logprobs (spec decode
+            # is gated off for logprob requests, so this stays aligned)
+            req.logprob_data.append(lp)
         self.last_token[slot] = token
         self.metrics.generated_tokens.inc()
         if req.stream:

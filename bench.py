@@ -41,9 +41,13 @@ import sys
 import time
 
 L4_BASELINE_TOKS = 2500.0
-TPU_TRIES = 3
-TPU_TIMEOUT_S = 1200        # backend init alone can take minutes over the tunnel
-CPU_TIMEOUT_S = 1200
+# Worst-case time-to-first-JSON: 2 x 900 s TPU attempts + 15 s backoff +
+# 600 s CPU fallback ≈ 40 min (typical success ~10 min: ~2 min backend init
+# over the tunnel + compile + measure; the CPU fallback runs the small
+# config and finishes in single-digit minutes).
+TPU_TRIES = 2
+TPU_TIMEOUT_S = 900
+CPU_TIMEOUT_S = 600
 RETRY_BACKOFF_S = 15
 
 
@@ -109,7 +113,27 @@ def main() -> None:
 # ---------------------------------------------------------------------------
 
 
+def _parent_watchdog() -> None:
+    """Exit the measurement child if its orchestrating parent dies.
+
+    An outer ``timeout N python bench.py`` kills only the parent; the
+    ``--measure`` child would keep running — and keep the TPU chip locked —
+    indefinitely (observed r2: an orphaned child wedged every subsequent
+    bench attempt). Reparenting to init (ppid 1) is the orphan signal.
+    """
+    import threading
+
+    def watch():
+        while True:
+            if os.getppid() == 1:
+                os._exit(3)
+            time.sleep(10)
+
+    threading.Thread(target=watch, daemon=True).start()
+
+
 def measure() -> None:
+    _parent_watchdog()
     import jax
     import jax.numpy as jnp
 

@@ -1,0 +1,178 @@
+"""OpenAI ``logprobs`` support: engine math + API wire format.
+
+vLLM (inside the reference's serving pods) returns per-token logprobs on
+request; here the engine computes them on-device only in the logprob program
+variants (engine._logprob_topk — the default hot path never pays the 152k-
+vocab log_softmax + top_k), and the server formats both the completions and
+chat payload shapes.
+"""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params, model_forward
+from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
+from aws_k8s_ansible_provisioner_tpu.serving.server import build_state, serve
+from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+
+def _drain(eng):
+    for _ in range(10000):
+        if not eng.step():
+            break
+
+
+def test_engine_logprobs_aligned_and_correct():
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    serving = ServingConfig(max_decode_slots=2, max_cache_len=64,
+                            prefill_buckets=(16,), dtype="float32",
+                            attention_impl="xla", prefix_cache=False)
+    eng = Engine(cfg, params, serving)
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(2, cfg.vocab_size, 7).tolist()
+    req = eng.submit(Request(prompt_ids=list(prompt), max_tokens=6,
+                             ignore_eos=True, logprobs=3))
+    _drain(eng)
+    assert len(req.logprob_data) == len(req.generated) == 6
+    for tok, (own, top) in zip(req.generated, req.logprob_data):
+        assert len(top) == 3
+        # greedy: the chosen token IS the top-1 alternative
+        assert top[0][0] == tok
+        np.testing.assert_allclose(own, top[0][1], rtol=1e-5)
+        assert own <= 0.0 and all(v <= 0.0 for _, v in top)
+        assert top[0][1] >= top[1][1] >= top[2][1]
+
+    # first generated token's logprob == log_softmax of the prompt forward
+    T = len(prompt)
+    positions = np.arange(T, dtype=np.int32)[None]
+    logits, _ = model_forward(params, cfg,
+                              jnp.asarray([prompt], jnp.int32),
+                              jnp.asarray(positions))
+    ref = jax.nn.log_softmax(logits[0, -1].astype(jnp.float32))
+    np.testing.assert_allclose(req.logprob_data[0][0],
+                               float(ref[req.generated[0]]), rtol=1e-4)
+
+
+def test_engine_logprobs_mixed_batch_and_chunked():
+    """A logprob request and a plain request share the batch; chunked prefill
+    supplies the first token's logprobs too."""
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    serving = ServingConfig(max_decode_slots=2, max_cache_len=64,
+                            prefill_buckets=(16,), dtype="float32",
+                            attention_impl="xla", prefix_cache=False,
+                            prefill_chunk=8)
+    eng = Engine(cfg, params, serving)
+    rng = np.random.default_rng(2)
+    long_prompt = rng.integers(2, cfg.vocab_size, 20).tolist()  # chunks
+    r1 = eng.submit(Request(prompt_ids=long_prompt, max_tokens=4,
+                            ignore_eos=True, logprobs=2))
+    r2 = eng.submit(Request(prompt_ids=[5, 6, 7], max_tokens=4,
+                            ignore_eos=True))
+    _drain(eng)
+    assert len(r1.logprob_data) == 4 and all(
+        d is not None for d in r1.logprob_data)
+    assert r2.logprob_data == []
+
+
+@pytest.fixture(scope="module")
+def server():
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    serving = ServingConfig(model="tiny-qwen3", max_decode_slots=4,
+                            max_cache_len=128, prefill_buckets=(16, 32),
+                            dtype="float32")
+    state = build_state(serving, model_cfg=cfg, params=params, tokenizer=tok)
+    ready, stop = threading.Event(), threading.Event()
+    t = threading.Thread(target=serve,
+                         args=(state, "127.0.0.1", 18127, ready, stop),
+                         daemon=True)
+    t.start()
+    assert ready.wait(10)
+    yield "http://127.0.0.1:18127"
+    stop.set()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_completions_logprobs_payload(server):
+    code, body = _post(server + "/v1/completions",
+                       {"model": "tiny-qwen3", "prompt": "hi there",
+                        "max_tokens": 5, "logprobs": 2})
+    assert code == 200
+    lp = body["choices"][0]["logprobs"]
+    assert len(lp["tokens"]) == 5
+    assert len(lp["token_logprobs"]) == 5
+    assert all(v <= 0.0 for v in lp["token_logprobs"])
+    assert all(len(d) <= 2 for d in lp["top_logprobs"])
+
+
+def test_chat_logprobs_payload(server):
+    code, body = _post(server + "/v1/chat/completions",
+                       {"model": "tiny-qwen3",
+                        "messages": [{"role": "user", "content": "hello"}],
+                        "max_tokens": 4, "logprobs": True,
+                        "top_logprobs": 3})
+    assert code == 200
+    content = body["choices"][0]["logprobs"]["content"]
+    assert len(content) == 4
+    assert all(len(c["top_logprobs"]) == 3 for c in content)
+    assert all(c["logprob"] <= 0.0 for c in content)
+
+
+def test_logprobs_validation(server):
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server + "/v1/completions",
+              {"model": "tiny-qwen3", "prompt": "x", "max_tokens": 2,
+               "logprobs": 99})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server + "/v1/completions",
+              {"model": "tiny-qwen3", "prompt": "x", "max_tokens": 2,
+               "logprobs": 2, "stream": True})
+    assert e.value.code == 400
+
+
+def test_completions_logprobs_zero_chosen_only(server):
+    """OpenAI semantics: logprobs=0 still returns the chosen token's logprob
+    (zero alternatives) — absent/null disables the feature."""
+    code, body = _post(server + "/v1/completions",
+                       {"model": "tiny-qwen3", "prompt": "abc",
+                        "max_tokens": 3, "logprobs": 0})
+    assert code == 200
+    lp = body["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == 3
+    assert all(v is not None and v <= 0.0 for v in lp["token_logprobs"])
+    assert all(d == {} for d in lp["top_logprobs"])
+    assert lp["text_offset"] == sorted(lp["text_offset"])
+
+    code, body = _post(server + "/v1/completions",
+                       {"model": "tiny-qwen3", "prompt": "abc",
+                        "max_tokens": 3})
+    assert body["choices"][0]["logprobs"] is None
+
+
+def test_completions_logprobs_stop_truncation_aligned(server):
+    """A stop-string cut must truncate the logprobs payload with the text."""
+    code, body = _post(server + "/v1/completions",
+                       {"model": "tiny-qwen3", "prompt": "hello world",
+                        "max_tokens": 8, "logprobs": 1, "stop": ["zzz-never"]})
+    assert code == 200
+    full = body["choices"][0]["logprobs"]
+    assert len(full["tokens"]) == 8   # no cut: full payload
