@@ -785,6 +785,7 @@ class Engine:
         lp_t = None
         if want_lp:
             self.cache, toks, lp_t = out
+            lp_t = tuple(np.asarray(a) for a in lp_t)  # ONE bulk transfer
         else:
             self.cache, toks = out
         toks = np.asarray(toks)  # device sync

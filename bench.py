@@ -123,9 +123,11 @@ def _parent_watchdog() -> None:
     """
     import threading
 
+    ppid0 = os.getppid()
+
     def watch():
         while True:
-            if os.getppid() == 1:
+            if os.getppid() != ppid0:   # reparented = parent died
                 os._exit(3)
             time.sleep(10)
 

@@ -176,3 +176,22 @@ def test_completions_logprobs_stop_truncation_aligned(server):
     assert code == 200
     full = body["choices"][0]["logprobs"]
     assert len(full["tokens"]) == 8   # no cut: full payload
+
+
+def test_format_logprobs_truncation_unit():
+    """Direct test of the text_len truncation branch (stop-string cuts)."""
+    from aws_k8s_ansible_provisioner_tpu.serving.server import _format_logprobs
+
+    tok = ByteTokenizer()
+    ids = tok.encode("abcdef")           # 1 byte per token
+    lp_data = [(-0.5, [(ids[i], -0.5)]) for i in range(len(ids))]
+    # cut after 3 chars: exactly 3 tokens survive
+    out = _format_logprobs(tok, ids, lp_data, 1, chat=False, text_len=3)
+    assert len(out["tokens"]) == 3
+    assert out["text_offset"] == [0, 1, 2]
+    # cut at 0: nothing survives
+    out0 = _format_logprobs(tok, ids, lp_data, 1, chat=False, text_len=0)
+    assert out0["tokens"] == [] and out0["token_logprobs"] == []
+    # chat shape truncates too
+    outc = _format_logprobs(tok, ids, lp_data, 1, chat=True, text_len=2)
+    assert len(outc["content"]) == 2

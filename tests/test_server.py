@@ -257,3 +257,21 @@ def test_debug_profile_captures_trace(server):
     for root, _, files in os.walk(body["trace_dir"]):
         found.extend(files)
     assert found, "profiler produced no trace artifacts"
+
+
+def test_n_choices(server):
+    code, body = _post(server + "/v1/completions",
+                       {"model": MODEL_NAME, "prompt": "hi", "max_tokens": 4,
+                        "n": 3})
+    assert code == 200
+    choices = body["choices"]
+    assert [c["index"] for c in choices] == [0, 1, 2]
+    # greedy: all n samples identical
+    assert len({c["text"] for c in choices}) == 1
+    assert body["usage"]["completion_tokens"] == 12
+
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server + "/v1/completions",
+              {"model": MODEL_NAME, "prompt": "x", "max_tokens": 2, "n": 99})
+    assert e.value.code == 400
