@@ -20,6 +20,22 @@ import jax.numpy as jnp
 MAX_TOPK = 64
 
 
+def apply_penalties(logits: jnp.ndarray, counts: jnp.ndarray,
+                    presence: jnp.ndarray,
+                    frequency: jnp.ndarray) -> jnp.ndarray:
+    """OpenAI presence/frequency penalties over GENERATED-token counts.
+
+    logits: [B, V]; counts: [B, V] int (occurrences of each token in the
+    slot's generated text so far); presence/frequency: [B]. Subtractive on
+    raw logits before any sampling — the vLLM semantics (greedy decode is
+    affected too). Zero penalties are exact no-ops.
+    """
+    c = counts.astype(jnp.float32)
+    return (logits.astype(jnp.float32)
+            - frequency[:, None] * c
+            - presence[:, None] * (c > 0))
+
+
 def sample(
     logits: jnp.ndarray,       # [B, V] float
     rng: jax.Array,

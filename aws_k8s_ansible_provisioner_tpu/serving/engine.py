@@ -51,7 +51,8 @@ from aws_k8s_ansible_provisioner_tpu.ops.attention import (
     make_prefill_attend_batch,
     make_spec_attend_carry,
 )
-from aws_k8s_ansible_provisioner_tpu.ops.sampling import sample
+from aws_k8s_ansible_provisioner_tpu.ops.sampling import (apply_penalties,
+                                                           sample)
 from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import EngineMetrics
 
@@ -82,6 +83,10 @@ class Request:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    # OpenAI presence/frequency penalties over the request's generated
+    # tokens (0.0 = off; subtractive on logits — ops/sampling.apply_penalties)
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
     ignore_eos: bool = False
     stream: bool = False
     cancelled: bool = False
@@ -144,6 +149,17 @@ def _host_lp(lp_t, row: int, k: int):
     ids = np.asarray(ids[row])
     k = min(k, len(ids))
     return (sel, [(int(ids[j]), float(vals[j])) for j in range(k)])
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _reset_count_row(counts, slot, token):
+    """Zero a recycled slot's generated-token counts and count its first
+    token (penalties apply over GENERATED text; the prefill-sampled token is
+    generated)."""
+    counts = jax.lax.dynamic_update_slice(
+        counts, jnp.zeros((1, counts.shape[1]), counts.dtype),
+        (slot, jnp.int32(0)))
+    return counts.at[slot, token].add(1)
 
 
 @partial(jax.jit, static_argnums=(0,), static_argnames=("logprobs",),
@@ -223,11 +239,14 @@ def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
 
 
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "impl",
-                                                          "logprobs"),
-         donate_argnums=(3,))
+                                                          "logprobs",
+                                                          "penalties"),
+         donate_argnums=(3,), donate_argnames=("counts",))
 def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
                  lengths, rng, temperature, top_k, top_p, mesh=None,
-                 impl: str = "auto", logprobs: bool = False):
+                 impl: str = "auto", logprobs: bool = False,
+                 counts=None, presence=None, frequency=None,
+                 penalties: bool = False):
     """``n_steps`` fused decode steps for every slot, one device dispatch.
 
     tokens/lengths/sampling params: [B]. Returns (cache, out [n_steps, B]).
@@ -244,7 +263,7 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
     """
 
     def body(carry, rng_i):
-        cache, tok, lens = carry
+        cache, cnts, tok, lens = carry
         positions = lens[:, None]
         # Carry-path forward: the cache stays in place in the scan carry and
         # attention reads it layer-indexed — no per-layer xs→ys copy (the
@@ -254,15 +273,27 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
                                           window=cfg.sliding_window)
         logits, cache = model_forward_carry(params, cfg, tok[:, None],
                                             positions, cache, attend)
-        nxt = sample(logits[:, 0, :], rng_i, temperature, top_k, top_p)
+        step_logits = logits[:, 0, :]
+        if penalties:
+            # presence/frequency over the [B, V] generated-token counts that
+            # ride the carry (updated per sampled token, so a mid-horizon
+            # repeat is penalized immediately, not at the next dispatch)
+            step_logits = apply_penalties(step_logits, cnts, presence,
+                                          frequency)
+        nxt = sample(step_logits, rng_i, temperature, top_k, top_p)
+        if penalties:
+            cnts = cnts.at[jnp.arange(cnts.shape[0]), nxt].add(1)
         if logprobs:
-            return (cache, nxt, lens + 1), (nxt,
-                                            _logprob_topk(logits[:, 0], nxt))
-        return (cache, nxt, lens + 1), nxt
+            return (cache, cnts, nxt, lens + 1), (
+                nxt, _logprob_topk(step_logits, nxt))
+        return (cache, cnts, nxt, lens + 1), nxt
 
+    if counts is None:
+        counts = jnp.zeros((tokens.shape[0], 1), jnp.int32)  # unused dummy
     rngs = jax.random.split(rng, n_steps)
-    (cache, _, _), out = jax.lax.scan(body, (cache, tokens, lengths), rngs)
-    return cache, out
+    (cache, counts, _, _), out = jax.lax.scan(
+        body, (cache, counts, tokens, lengths), rngs)
+    return cache, counts, out
 
 
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("impl",),
@@ -424,6 +455,12 @@ class Engine:
         self.temps = np.zeros(self.num_slots, np.float32)
         self.top_ks = np.zeros(self.num_slots, np.int32)
         self.top_ps = np.ones(self.num_slots, np.float32)
+        self.pres_pens = np.zeros(self.num_slots, np.float32)
+        self.freq_pens = np.zeros(self.num_slots, np.float32)
+        # [num_slots, V] generated-token counts, allocated lazily on the
+        # first penalized request (78 MB at Qwen3 vocab x 128 slots — only
+        # paid when the feature is used); rides decode_steps' donated carry.
+        self.counts = None
         self.slot_req: List[Optional[Request]] = [None] * self.num_slots
         # Admission queue + slot lifecycle live in the runtime core (native
         # C++ when built — see native/runtime; Python fallback otherwise).
@@ -725,6 +762,18 @@ class Engine:
         self.temps[slot] = req.temperature
         self.top_ks[slot] = req.top_k
         self.top_ps[slot] = req.top_p
+        self.pres_pens[slot] = req.presence_penalty
+        self.freq_pens[slot] = req.frequency_penalty
+        if req.presence_penalty or req.frequency_penalty:
+            # Only penalized occupants touch the counts array: a stale row
+            # under a zero-penalty occupant is multiplied by zero, so
+            # un-penalized prefills never pay this extra device dispatch.
+            if self.counts is None:
+                self.counts = jnp.zeros(
+                    (self.num_slots, self.cfg.vocab_size), jnp.int32)
+            # zero the recycled slot's row, then count the first token
+            self.counts = _reset_count_row(self.counts, jnp.int32(slot),
+                                           jnp.int32(token))
         self.sched.note_prefill(slot, len(req.prompt_ids))
         self.metrics.active_requests.set(len(self._active_slots()))
         self._emit(slot, token, lp)
@@ -957,6 +1006,8 @@ class Engine:
         # slot; a dp mesh would desync). Falls back when no context matched.
         if (self.serving.spec_decode and self.mesh is None and horizon > 1
                 and not self._want_logprobs(self.slot_req)
+                and not (self.counts is not None
+                         and (self.pres_pens.any() or self.freq_pens.any()))
                 and self.lengths[active].max(initial=0) + self.serving.spec_k
                 + 1 < self.max_len):
             proposal = self._propose_drafts(active)
@@ -964,13 +1015,22 @@ class Engine:
                 self._do_spec_decode(active, *proposal)
                 return
         want_lp = self._want_logprobs(self.slot_req)
-        self.cache, out = decode_steps(
+        want_pen = self.counts is not None and bool(
+            self.pres_pens.any() or self.freq_pens.any())
+        real_counts = self.counts
+        self.cache, new_counts, out = decode_steps(
             self.cfg, horizon, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
             self._next_rng(), jnp.asarray(self.temps),
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
             mesh=self.mesh, impl=self.serving.attention_impl,
-            logprobs=want_lp)
+            logprobs=want_lp,
+            counts=self.counts if want_pen else None,
+            presence=jnp.asarray(self.pres_pens) if want_pen else None,
+            frequency=jnp.asarray(self.freq_pens) if want_pen else None,
+            penalties=want_pen)
+        # un-penalized dispatches return a dummy counts array — keep ours
+        self.counts = new_counts if want_pen else real_counts
         lp_t = None
         if want_lp:
             out, lp_t = out          # ([h, B], ([h,B], [h,B,K], [h,B,K]))
@@ -1037,6 +1097,8 @@ class Engine:
         # stay past the prompt (generation length >= 1 guarantees
         # final length >= prompt length).
         self.temps[slot] = 0.0
+        self.pres_pens[slot] = 0.0
+        self.freq_pens[slot] = 0.0
         self.sched.release(slot)
         self.metrics.active_requests.set(len(self._active_slots()))
         req.out_queue.put(None)  # sentinel: done
@@ -1055,6 +1117,7 @@ class Engine:
 
         log = logging.getLogger(__name__)
         while not stop.is_set():
+            self.last_step_start = time.monotonic()
             try:
                 did_work = self.step()
             except Exception as e:
@@ -1062,11 +1125,29 @@ class Engine:
                 self.last_error = f"{type(e).__name__}: {e}"
                 self._fail_all(self.last_error)
                 did_work = False
+            self.last_step_start = 0.0
             if not did_work:
                 self._work_event.wait(timeout=0.05)
                 self._work_event.clear()
 
     last_error: str = ""
+    # monotonic timestamp of the step currently executing (0.0 = idle):
+    # /health derives a "stalled" status from it — a wedged device dispatch
+    # (hung tunnel, driver fault) hangs INSIDE step() and would otherwise
+    # look healthy forever, since run_forever never returns to record an
+    # error (failure-detection beyond the reference's set -e, SURVEY.md §5).
+    last_step_start: float = 0.0
+    STALL_AFTER_S: float = 120.0
+
+    @property
+    def stalled_for_s(self) -> float:
+        """Seconds the current step has been executing past the stall
+        threshold (0.0 = healthy/idle)."""
+        t0 = self.last_step_start
+        if not t0:
+            return 0.0
+        dt = time.monotonic() - t0
+        return dt if dt >= self.STALL_AFTER_S else 0.0
 
     def _fail_all(self, reason: str):
         if self._chunk is not None:  # fail the half-prefilled request too
@@ -1164,20 +1245,28 @@ class Engine:
                         max_tokens=self.serving.spec_k + 2, ignore_eos=True)
             self.submit(r)
             drain()
-        # compile the fused decode program too (horizon path)
+        # compile the fused decode program too (horizon path), and its
+        # penalties variant ('penalties' is a static arg — a distinct
+        # program): the first penalized request must not pay a 20-40s XLA
+        # compile inside step(), freezing every in-flight stream (and
+        # burning most of the /health stall budget).
         horizon = max(1, self.serving.decode_horizon)
         if horizon > 1:
             r = Request(prompt_ids=[0] * 4, max_tokens=horizon + 1,
                         ignore_eos=True)
             self.submit(r)
             drain()
+        self.submit(Request(prompt_ids=[1] * 4,
+                            max_tokens=max(2, horizon + 1), ignore_eos=True,
+                            presence_penalty=0.01))
+        drain()
         # The horizon=1 decode variant (selected whenever a prefill is
         # possible) is a distinct compiled program (n_steps is static);
         # compile it now so the first decode overlapping a queued request
         # doesn't stall all in-flight streams on XLA. Direct call, no slot
         # state touched: writes land at position 0 of idle slots and are
         # overwritten by real prefills.
-        self.cache, _ = decode_steps(
+        self.cache, _, _ = decode_steps(
             self.cfg, 1, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
             self._next_rng(), jnp.asarray(self.temps),

@@ -162,12 +162,22 @@ class Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         elif path in ("/health", "/healthz", "/ping"):
             eng = self.state.engine
-            self._json(200, {
-                "status": "degraded" if eng.last_error else "ok",
+            stalled = eng.stalled_for_s
+            status = "ok"
+            if eng.last_error:
+                status = "degraded"
+            if stalled:
+                # a wedged device dispatch hangs inside step(); K8s liveness
+                # keys off this to restart the pod (the engine thread cannot
+                # recover a hung XLA call itself)
+                status = "stalled"
+            self._json(503 if stalled else 200, {
+                "status": status,
                 "model": self.state.model_name,
                 "uptime_s": _now() - self.state.started,
                 "active_requests": len(eng._active_slots()),
                 "queue_depth": len(eng.pending),
+                "stalled_for_s": round(stalled, 1) or None,
                 "last_error": eng.last_error or None,
             })
         elif path == "/debug/profile":
@@ -261,8 +271,13 @@ class Handler(BaseHTTPRequestHandler):
             temperature = float(body.get("temperature", 1.0 if chat else 0.0))
             top_p = float(body.get("top_p", 1.0))
             top_k = int(body.get("top_k", 0))
+            presence_penalty = float(body.get("presence_penalty", 0.0))
+            frequency_penalty = float(body.get("frequency_penalty", 0.0))
         except (TypeError, ValueError):
             return self._error(400, "sampling parameters must be numeric")
+        if not (-2.0 <= presence_penalty <= 2.0
+                and -2.0 <= frequency_penalty <= 2.0):
+            return self._error(400, "penalties must be in [-2, 2]")
         if max_tokens < 1 or max_tokens > st.engine.max_len:
             return self._error(400, f"max_tokens must be in [1, "
                                     f"{st.engine.max_len}]")
@@ -290,7 +305,9 @@ class Handler(BaseHTTPRequestHandler):
                     if bool(body.get("logprobs", False)) else None
             else:
                 raw_lp = body.get("logprobs", None)
-                if isinstance(raw_lp, bool):
+                if raw_lp is False:
+                    raw_lp = None   # explicit false unambiguously means off
+                elif isinstance(raw_lp, bool):
                     # bool is an int subclass: the chat-style {"logprobs":
                     # true} on /v1/completions is a client bug, not a 1
                     return self._error(400, "completions 'logprobs' is an "
@@ -308,12 +325,16 @@ class Handler(BaseHTTPRequestHandler):
         if not prompt_ids:
             prompt_ids = [st.engine.eos_token_id]
         try:
-            # n > 1: n independent engine requests riding the same continuous
-            # batch (they prefix-cache-share the prompt rows when enabled) —
-            # the OpenAI ``n`` semantics; identical for temperature=0.
+            # n > 1: n independent engine requests riding the same
+            # continuous batch — the OpenAI ``n`` semantics; identical for
+            # temperature=0. Each sibling prefills the prompt itself (the
+            # prefix cache only consults on ISOLATED arrivals, and the
+            # siblings queue together), so n multiplies prefill cost.
             reqs = [st.engine.generate(
                 prompt_ids, max_tokens=max_tokens, temperature=temperature,
-                top_k=top_k, top_p=top_p, stream=stream, logprobs=lp_n)
+                top_k=top_k, top_p=top_p, stream=stream, logprobs=lp_n,
+                presence_penalty=presence_penalty,
+                frequency_penalty=frequency_penalty)
                 for _ in range(n_choices)]
         except ContextLengthExceeded as e:
             # Same wire shape the reference's vLLM returns for an oversized

@@ -275,3 +275,24 @@ def test_n_choices(server):
         _post(server + "/v1/completions",
               {"model": MODEL_NAME, "prompt": "x", "max_tokens": 2, "n": 99})
     assert e.value.code == 400
+
+
+def test_engine_stall_detection():
+    """A step wedged past STALL_AFTER_S is visible via stalled_for_s (the
+    /health route turns it into a 503 'stalled' so the K8s liveness probe
+    restarts the pod — a hung XLA dispatch can't be recovered in-process)."""
+    import time as _time
+
+    from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine
+    from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    eng = Engine(cfg, params, ServingConfig(
+        max_decode_slots=2, max_cache_len=64, prefill_buckets=(16,),
+        dtype="float32"))
+    assert eng.stalled_for_s == 0.0                      # idle
+    eng.last_step_start = _time.monotonic() - 1.0
+    assert eng.stalled_for_s == 0.0                      # healthy in-step
+    eng.last_step_start = _time.monotonic() - eng.STALL_AFTER_S - 5
+    assert eng.stalled_for_s > 0.0                       # wedged
