@@ -394,3 +394,181 @@ def make_prefill_attend(slot: jnp.ndarray, seq_len: jnp.ndarray,
         return ctx, cache_l
 
     return attend
+
+
+# ---------------------------------------------------------------------------
+# Paged variants (serving/paged_kv.py pool + block tables). Same contracts as
+# their dense counterparts; the ONLY difference is physical addressing via the
+# per-slot page table. Single-device path (the dp/tp/sp mesh serves the dense
+# layout; a per-dp-group pool is future work, documented in ServingConfig).
+# ---------------------------------------------------------------------------
+
+
+def make_decode_attend_carry_paged(lengths: jnp.ndarray, table: jnp.ndarray,
+                                   impl: str = "auto", window: int = 0):
+    """Carry-path decode attend over the PAGED pool: cache_l is
+    ``(pool, layer_idx)``; ``table`` [B, max_pages] int32 maps each slot's
+    logical pages to physical pool pages. The engine guarantees every row in
+    [0, lengths[b] + 1) — and the row being written — lives in an allocated
+    page (Engine._ensure_pages)."""
+    resolved = resolve_impl(impl)
+
+    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, tuple]:
+        from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
+
+        pool, layer = cache_l
+        ps = pool["k"].shape[3]
+        if resolved == "pallas":
+            from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
+
+            interpret = jax.default_backend() != "tpu"
+            knew, vnew = k[:, 0], v[:, 0]
+            ck, cv = pool["k"], pool["v"]
+            if "ks" in pool:
+                ck, ks = pallas_attention.cache_write_row_quant_paged(
+                    ck, pool["ks"], knew, lengths, table, layer,
+                    interpret=interpret)
+                cv, vs = pallas_attention.cache_write_row_quant_paged(
+                    cv, pool["vs"], vnew, lengths, table, layer,
+                    interpret=interpret)
+                pool = {"k": ck, "v": cv, "ks": ks, "vs": vs}
+                scale_kw = dict(pool_ks=ks, pool_vs=vs)
+            else:
+                ck = pallas_attention.cache_write_row_paged(
+                    ck, knew, lengths, table, layer, interpret=interpret)
+                cv = pallas_attention.cache_write_row_paged(
+                    cv, vnew, lengths, table, layer, interpret=interpret)
+                pool = {"k": ck, "v": cv}
+                scale_kw = {}
+            ctx = pallas_attention.decode_attend_pallas_paged(
+                q, ck, cv, lengths + 1, layer, table, interpret=interpret,
+                window=window, **scale_kw)
+            return ctx, (pool, layer)
+        pool = pkv.write_token_layer_paged(pool, layer, lengths, table, k, v,
+                                           ps)
+        dense = pkv.gather_layer_dense(pool, layer, table)
+        ck, cv = dense["k"], dense["v"]
+        if "ks" in dense:
+            ck = kvc.dequantize(ck, dense["ks"], dtype=q.dtype)
+            cv = kvc.dequantize(cv, dense["vs"], dtype=q.dtype)
+        ctx = decode_attend(q, ck, cv, lengths + 1, window=window)
+        return ctx, (pool, layer)
+
+    return attend
+
+
+def make_spec_attend_carry_paged(lengths: jnp.ndarray, table: jnp.ndarray,
+                                 impl: str = "auto", window: int = 0):
+    """Paged speculative verify: R rows written across pages, one flash pass
+    answers all R queries (pages covering lengths + R pre-allocated by the
+    engine)."""
+    resolved = resolve_impl(impl)
+
+    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, tuple]:
+        from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
+
+        pool, layer = cache_l
+        ps = pool["k"].shape[3]
+        R = q.shape[1]
+        if resolved == "pallas":
+            from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
+
+            interpret = jax.default_backend() != "tpu"
+            ck, cv = pool["k"], pool["v"]
+            if "ks" in pool:
+                ks, vs = pool["ks"], pool["vs"]
+                for r in range(R):
+                    ck, ks = pallas_attention.cache_write_row_quant_paged(
+                        ck, ks, k[:, r], lengths + r, table, layer,
+                        interpret=interpret)
+                    cv, vs = pallas_attention.cache_write_row_quant_paged(
+                        cv, vs, v[:, r], lengths + r, table, layer,
+                        interpret=interpret)
+                pool = {"k": ck, "v": cv, "ks": ks, "vs": vs}
+                scale_kw = dict(pool_ks=ks, pool_vs=vs)
+            else:
+                for r in range(R):
+                    ck = pallas_attention.cache_write_row_paged(
+                        ck, k[:, r], lengths + r, table, layer,
+                        interpret=interpret)
+                    cv = pallas_attention.cache_write_row_paged(
+                        cv, v[:, r], lengths + r, table, layer,
+                        interpret=interpret)
+                pool = {"k": ck, "v": cv}
+                scale_kw = {}
+            ctx = pallas_attention.decode_attend_pallas_spec_paged(
+                q, ck, cv, lengths, layer, table, interpret=interpret,
+                window=window, **scale_kw)
+            return ctx, (pool, layer)
+        for r in range(R):
+            pool = pkv.write_token_layer_paged(pool, layer, lengths + r,
+                                               table, k[:, r:r + 1],
+                                               v[:, r:r + 1], ps)
+        dense = pkv.gather_layer_dense(pool, layer, table)
+        ck, cv = dense["k"], dense["v"]
+        if "ks" in dense:
+            ck = kvc.dequantize(ck, dense["ks"], dtype=q.dtype)
+            cv = kvc.dequantize(cv, dense["vs"], dtype=q.dtype)
+        ctx = decode_attend_multi(q, ck, cv, lengths, window=window)
+        return ctx, (pool, layer)
+
+    return attend
+
+
+def make_prefill_attend_paged(pages: jnp.ndarray, seq_len: jnp.ndarray,
+                              window: int = 0):
+    """Paged single-sequence prefill: causal attention + page-scattered
+    write (paged_kv.write_prompt_paged). ``pages`` [max_pages] int32."""
+    from aws_k8s_ansible_provisioner_tpu.models.layers import causal_attend
+
+    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
+        from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
+
+        ps = cache_l["k"].shape[2]
+        ctx = causal_attend(q, k, v, seq_lens=seq_len[None], window=window)
+        cache_l = pkv.write_prompt_paged(cache_l, pages, k, v, ps)
+        return ctx, cache_l
+
+    return attend
+
+
+def make_prefill_attend_batch_paged(tables: jnp.ndarray,
+                                    seq_lens: jnp.ndarray, window: int = 0):
+    """Paged batched prefill: N prompts scattered to their pages in one
+    dispatch. Padding rows carry all -1 tables (writes drop)."""
+    from aws_k8s_ansible_provisioner_tpu.models.layers import causal_attend
+
+    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
+        from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
+
+        ps = cache_l["k"].shape[2]
+        ctx = causal_attend(q, k, v, seq_lens=seq_lens, window=window)
+        cache_l = pkv.write_prompts_paged(cache_l, tables, k, v, ps)
+        return ctx, cache_l
+
+    return attend
+
+
+def make_chunk_prefill_attend_paged(pages: jnp.ndarray, start: jnp.ndarray,
+                                    window: int = 0):
+    """Paged chunked prefill: write the chunk's rows across pages, then
+    attend the chunk queries over the slot's gathered page prefix. The
+    gather materializes one slot's logical view per layer — a prefill-only
+    cost, amortized over the chunk's tokens (decode never gathers)."""
+
+    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
+        from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
+
+        ps = cache_l["k"].shape[2]
+        cache_l = pkv.write_chunk_paged(cache_l, pages, start, k, v, ps)
+        ck = pkv.gather_slot(cache_l, pages, ps, "k")
+        cv = pkv.gather_slot(cache_l, pages, ps, "v")
+        if "ks" in cache_l:
+            ck = kvc.dequantize(ck, pkv.gather_slot(cache_l, pages, ps, "ks"),
+                                dtype=q.dtype)
+            cv = kvc.dequantize(cv, pkv.gather_slot(cache_l, pages, ps, "vs"),
+                                dtype=q.dtype)
+        ctx = chunk_attend(q, ck, cv, start, window=window)
+        return ctx, cache_l
+
+    return attend

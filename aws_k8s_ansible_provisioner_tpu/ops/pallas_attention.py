@@ -672,6 +672,313 @@ def cache_write_row_quant(cache: jnp.ndarray, scales: jnp.ndarray,
     )(lengths, layer_arr, new, cache, scales)
 
 
+# ---------------------------------------------------------------------------
+# Paged variants: physical page pool + per-slot block tables
+# ---------------------------------------------------------------------------
+#
+# The dense kernels above address chunk c of slot b at cache[(lay, b, :,
+# c*CHUNK:(c+1)*CHUNK)] — an IDENTITY block table (kv_cache.pages_view). The
+# paged variants below are the same flash bodies with ONE change: the block
+# table arrives as a third scalar-prefetch operand and the index_map fetches
+# physical page ``table[b, c]`` from the pool [L, P, Hkv, page, D]
+# (serving/paged_kv.py). chunk == page_size, the grid's logical page axis is
+# the table width, and the DMA-skip clamp works unchanged: a dead logical
+# page clamps to the last live one, whose repeated PHYSICAL index suppresses
+# the re-fetch. This is the TPU analogue of vLLM's paged-attention block
+# indirection (SURVEY.md §2.2 row 1), with the page gather done by the DMA
+# engine per grid step instead of a materialized gather in HBM.
+
+
+def _with_table(kernel):
+    """Adapt a (lengths, layer, ...) kernel to the paged scalar-prefetch
+    order (lengths, layer, table, ...): the flash bodies never read the table
+    — only the index maps do."""
+    def wrapped(lengths_ref, layer_ref, table_ref, *rest, **kw):
+        return kernel(lengths_ref, layer_ref, *rest, **kw)
+    return wrapped
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "window"))
+def decode_attend_pallas_paged(q: jnp.ndarray, pool_k: jnp.ndarray,
+                               pool_v: jnp.ndarray, lengths: jnp.ndarray,
+                               layer: jnp.ndarray, table: jnp.ndarray,
+                               interpret: bool = False,
+                               pool_ks: jnp.ndarray = None,
+                               pool_vs: jnp.ndarray = None,
+                               window: int = 0):
+    """Flash decode attention over one layer of the PAGED pool.
+
+    q: [B, 1, Hq, D]; pool_k/v: [L, P, Hkv, page, D]; lengths: [B] (counting
+    the just-written token); layer: scalar int32; table: [B, max_pages] int32
+    physical page ids (row b maps slot b's logical pages; entries at or past
+    the slot's live range may be any valid id — they are clamped away, never
+    fetched). Returns [B, 1, Hq, D]. pool_ks/vs switch the int8 scale-folding
+    body, as in the dense kernel.
+    """
+    B, _, Hq, D = q.shape
+    Hkv, ps = pool_k.shape[2], pool_k.shape[3]
+    groups = Hq // Hkv
+    quant = pool_ks is not None
+    max_pages = table.shape[1]
+    lengths = lengths.astype(jnp.int32)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    table = table.astype(jnp.int32)
+
+    def q_map(b, c, lens, lay, tab):
+        return (b, 0, 0)
+
+    def _phys(b, c, lens, tab):
+        hi = jnp.maximum(pl.cdiv(lens[b], ps) - 1, 0)
+        if window > 0:
+            lo_page = jnp.maximum(lens[b] - window, 0) // ps
+            c = jnp.clip(c, lo_page, hi)
+        else:
+            c = jnp.minimum(c, hi)
+        return tab[b, c]
+
+    def kv_map(b, c, lens, lay, tab):
+        return (lay[0], _phys(b, c, lens, tab), 0, 0, 0)
+
+    def scale_map(b, c, lens, lay, tab):
+        return (lay[0], _phys(b, c, lens, tab), 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, Hq, D), q_map),
+        pl.BlockSpec((1, 1, Hkv, ps, D), kv_map),
+        pl.BlockSpec((1, 1, Hkv, ps, D), kv_map),
+    ]
+    operands = [q[:, 0], pool_k, pool_v]
+    if quant:
+        # scale block spans the FULL page axis (the array's lane axis), which
+        # Mosaic always allows — no 128-multiple constraint on page_size
+        in_specs += [pl.BlockSpec((1, 1, Hkv, ps), scale_map)] * 2
+        operands += [pool_ks, pool_vs]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, max_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, Hq, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((Hq, D), jnp.float32),
+            pltpu.VMEM((Hq, 128), jnp.float32),
+            pltpu.VMEM((Hq, 128), jnp.float32),
+        ],
+    )
+    kernel = _with_table(functools.partial(
+        _decode_kernel_layer_q if quant else _decode_kernel_layer,
+        chunk=ps, groups=groups, scale=1.0 / (D ** 0.5), window=window))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        interpret=interpret,
+    )(lengths, layer_arr, table, *operands)
+    return out[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "window"))
+def decode_attend_pallas_spec_paged(q: jnp.ndarray, pool_k: jnp.ndarray,
+                                    pool_v: jnp.ndarray, lengths: jnp.ndarray,
+                                    layer: jnp.ndarray, table: jnp.ndarray,
+                                    interpret: bool = False,
+                                    pool_ks: jnp.ndarray = None,
+                                    pool_vs: jnp.ndarray = None,
+                                    window: int = 0) -> jnp.ndarray:
+    """Paged speculative-verify attention: R query rows per slot, one pass.
+
+    q: [B, R, Hq, D]; row r masks to columns < lengths + 1 + r. The caller
+    has already written all R rows (their pages allocated up front — the
+    engine's ensure-pages step covers lengths + R). Same economics as the
+    dense spec kernel: one page stream serves all R queries.
+    """
+    B, R, Hq, D = q.shape
+    Hkv, ps = pool_k.shape[2], pool_k.shape[3]
+    groups = Hq // Hkv
+    quant = pool_ks is not None
+    max_pages = table.shape[1]
+    lengths = lengths.astype(jnp.int32)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    table = table.astype(jnp.int32)
+
+    def q_map(b, c, lens, lay, tab):
+        return (b, 0, 0)
+
+    def _phys(b, c, lens, tab):
+        hi = jnp.maximum(pl.cdiv(lens[b] + R, ps) - 1, 0)
+        if window > 0:
+            lo_page = jnp.maximum(lens[b] + 1 - window, 0) // ps
+            c = jnp.clip(c, lo_page, hi)
+        else:
+            c = jnp.minimum(c, hi)
+        return tab[b, c]
+
+    def kv_map(b, c, lens, lay, tab):
+        return (lay[0], _phys(b, c, lens, tab), 0, 0, 0)
+
+    def scale_map(b, c, lens, lay, tab):
+        return (lay[0], _phys(b, c, lens, tab), 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, R * Hq, D), q_map),
+        pl.BlockSpec((1, 1, Hkv, ps, D), kv_map),
+        pl.BlockSpec((1, 1, Hkv, ps, D), kv_map),
+    ]
+    operands = [q.reshape(B, R * Hq, D), pool_k, pool_v]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1, Hkv, ps), scale_map)] * 2
+        operands += [pool_ks, pool_vs]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, max_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, R * Hq, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((R * Hq, D), jnp.float32),
+            pltpu.VMEM((R * Hq, 128), jnp.float32),
+            pltpu.VMEM((R * Hq, 128), jnp.float32),
+        ],
+    )
+    kernel = _with_table(functools.partial(
+        _spec_kernel_quant if quant else _spec_kernel_plain,
+        chunk=ps, groups=groups, scale=1.0 / (D ** 0.5), R=R, window=window))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, R * Hq, D), q.dtype),
+        interpret=interpret,
+    )(lengths, layer_arr, table, *operands)
+    return out.reshape(B, R, Hq, D)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def cache_write_row_paged(pool: jnp.ndarray, new: jnp.ndarray,
+                          rows: jnp.ndarray, table: jnp.ndarray,
+                          layer: jnp.ndarray,
+                          interpret: bool = False) -> jnp.ndarray:
+    """Write one new K (or V) row per slot into the PAGED pool, IN PLACE.
+
+    pool: [L, P, Hkv, page, D]; new: [B, Hkv, D]; rows: [B] logical row per
+    slot; table: [B, max_pages] int32; layer: scalar. Rows outside
+    [0, max_pages*page) DROP (surplus-write invariant). Same aliased-output
+    design as the dense cache_write_row (see its docstring for why a kernel
+    and not a scatter).
+    """
+    L, P, Hkv, ps, D = pool.shape
+    rows = rows.astype(jnp.int32)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    table = table.astype(jnp.int32)
+    S_v = table.shape[1] * ps
+    ROWS = 8 if ps % 8 == 0 else ps
+
+    def new_map(b, lens, lay, tab):
+        return (b, 0, 0)
+
+    def blk_map(b, lens, lay, tab):
+        r = jnp.clip(lens[b], 0, S_v - 1)
+        return (lay[0], tab[b, r // ps], 0, (r % ps) // ROWS, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B := new.shape[0],),
+        in_specs=[
+            pl.BlockSpec((1, Hkv, D), new_map),
+            pl.BlockSpec((1, 1, Hkv, ROWS, D), blk_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, Hkv, ROWS, D), blk_map),
+    )
+
+    def kernel(lengths_ref, layer_ref, table_ref, new_ref, cin_ref, cout_ref):
+        b = pl.program_id(0)
+        tgt = lengths_ref[b]
+        in_window = (tgt >= 0) & (tgt < S_v)
+        # ROWS divides page_size, so the in-block row is tgt % ROWS
+        r = jnp.where(in_window, jnp.clip(tgt, 0, S_v - 1) % ROWS, -1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (Hkv, ROWS, D), 1)
+        cout_ref[0, 0] = jnp.where(row == r, new_ref[0][:, None, :],
+                                   cin_ref[0, 0])
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(pool.shape, pool.dtype),
+        input_output_aliases={4: 0},   # pool operand (after 3 scalars + new)
+        interpret=interpret,
+    )(rows, layer_arr, table, new, pool)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def cache_write_row_quant_paged(pool: jnp.ndarray, scales: jnp.ndarray,
+                                new: jnp.ndarray, rows: jnp.ndarray,
+                                table: jnp.ndarray, layer: jnp.ndarray,
+                                interpret: bool = False):
+    """Quantizing paged row write: int8 pool + per-row scales, both aliased.
+
+    pool: [L, P, Hkv, page, D] int8; scales: [L, P, Hkv, page] f32; new:
+    [B, Hkv, D] float. Same quantizer as the dense kernel
+    (kv_cache.quantize_rows) so prefilled and decoded rows are
+    interchangeable. Returns (pool, scales) — same buffers.
+    """
+    L, P, Hkv, ps, D = pool.shape
+    rows = rows.astype(jnp.int32)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    table = table.astype(jnp.int32)
+    S_v = table.shape[1] * ps
+    ROWS = 32 if ps % 32 == 0 else ps
+
+    def new_map(b, lens, lay, tab):
+        return (b, 0, 0)
+
+    def blk_map(b, lens, lay, tab):
+        r = jnp.clip(lens[b], 0, S_v - 1)
+        return (lay[0], tab[b, r // ps], 0, (r % ps) // ROWS, 0)
+
+    def scale_map(b, lens, lay, tab):
+        r = jnp.clip(lens[b], 0, S_v - 1)
+        return (lay[0], tab[b, r // ps], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(new.shape[0],),
+        in_specs=[
+            pl.BlockSpec((1, Hkv, D), new_map),
+            pl.BlockSpec((1, 1, Hkv, ROWS, D), blk_map),
+            pl.BlockSpec((1, 1, Hkv, ps), scale_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, Hkv, ROWS, D), blk_map),
+            pl.BlockSpec((1, 1, Hkv, ps), scale_map),
+        ],
+    )
+
+    def kernel(lengths_ref, layer_ref, table_ref, new_ref, cin_ref, sin_ref,
+               cout_ref, sout_ref):
+        b = pl.program_id(0)
+        tgt = lengths_ref[b]
+        in_window = (tgt >= 0) & (tgt < S_v)
+        r = jnp.where(in_window, jnp.clip(tgt, 0, S_v - 1) % ROWS, -1)
+        from aws_k8s_ansible_provisioner_tpu.serving.kv_cache import (
+            quantize_rows)
+
+        q8, sc = quantize_rows(new_ref[0])                    # [Hkv,D],[Hkv]
+        row = jax.lax.broadcasted_iota(jnp.int32, (Hkv, ROWS, D), 1)
+        cout_ref[0, 0] = jnp.where(row == r, q8[:, None, :], cin_ref[0, 0])
+        # scale block spans one whole page: target column = tgt % page
+        rs = jax.lax.broadcasted_iota(jnp.int32, (Hkv, ps), 1)
+        tgt_col = jnp.where(in_window, jnp.clip(tgt, 0, S_v - 1) % ps, -1)
+        sout_ref[0, 0] = jnp.where(rs == tgt_col, sc[:, None], sin_ref[0, 0])
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(pool.shape, pool.dtype),
+            jax.ShapeDtypeStruct(scales.shape, scales.dtype),
+        ],
+        input_output_aliases={4: 0, 5: 1},  # pool, scales (3 scalars + new)
+        interpret=interpret,
+    )(rows, layer_arr, table, new, pool, scales)
+
+
 def supported(cfg=None) -> bool:
     """Pallas decode path is compiled only on TPU backends (interpret elsewhere)."""
     try:

@@ -927,7 +927,12 @@ class Engine:
         K = self.serving.spec_k
         n = self.serving.spec_ngram
         drafts = np.zeros((self.num_slots, K), np.int32)
-        proposed: List[int] = []
+        # {slot: true draft count} — drafts shorter than spec_k are
+        # zero-padded for the verify dispatch, and the verify argmax can
+        # "accept" a padding zero; the metrics below clamp to these counts
+        # so the reported acceptance rate covers only real proposed tokens
+        # (ADVICE r2).
+        proposed: dict = {}
         for slot in active:
             req = self.slot_req[slot]
             # Only greedy slots can accept drafts (sampled slots always fall
@@ -947,11 +952,11 @@ class Engine:
             if cont.size == 0:
                 continue
             drafts[slot, :cont.size] = cont
-            proposed.append(slot)
+            proposed[slot] = int(cont.size)
         return (drafts, proposed) if proposed else None
 
     def _do_spec_decode(self, active: List[int], drafts,
-                        proposed: List[int]) -> None:
+                        proposed: dict) -> None:
         """One speculative verify dispatch: up to spec_k + 1 tokens per slot."""
         t0 = time.monotonic()
         R = self.serving.spec_k + 1
@@ -965,13 +970,17 @@ class Engine:
         accepted = np.asarray(accepted)
         dt = time.monotonic() - t0
         self.metrics.device_busy_seconds.inc(dt)
-        proposed_set = set(proposed)
         emitted = 0
         for slot in active:
             acc = int(accepted[slot])
-            if slot in proposed_set:  # acceptance rate over REAL proposals
-                self.metrics.spec_drafted_tokens.inc(self.serving.spec_k)
-                self.metrics.spec_accepted_tokens.inc(acc - 1)
+            if slot in proposed:  # acceptance rate over REAL proposals
+                # clamp both sides to the slot's true draft count: the verify
+                # pass can "accept" zero-padding past a short draft, which
+                # would otherwise inflate the acceptance rate (ADVICE r2)
+                n_drafted = proposed[slot]
+                self.metrics.spec_drafted_tokens.inc(n_drafted)
+                self.metrics.spec_accepted_tokens.inc(
+                    min(max(acc - 1, 0), n_drafted))
             for i in range(acc):
                 if self.slot_req[slot] is None:
                     break  # hit a stop condition mid-prefix
@@ -1186,13 +1195,45 @@ class Engine:
                 break
         self.metrics.queue_depth.set(self.sched.stats().queue_depth)
 
-    def warmup(self):
-        """Pre-compile every program (each prefill bucket + decode) so the first
-        real request doesn't pay 20-40s of XLA compile time."""
+    def warmup(self, scope: str = "full"):
+        """Pre-compile programs so the first real request doesn't pay 20-40s
+        of XLA compile time per program.
+
+        scope="full" (serving): every variant — each prefill bucket, batched/
+        chunked prefill, prefix cache, speculative, penalties, logprobs, both
+        decode horizons. ~10 programs; over a network-attached chip this is
+        minutes of XLA time, which is fine at server startup (the readiness
+        probe gates traffic) but NOT inside a bounded benchmark window.
+
+        scope="bench": only the two programs the benchmark path executes —
+        the full-width batched prefill and the fused-horizon decode (bench
+        prompts sit below the prefix-cache min length, spec decode is off,
+        and the fill loop admits batches until the queue drains, so no other
+        program is ever dispatched). This is what lets bench.py fit warmup +
+        measurement inside the driver's ~900s budget (BENCH_r02 postmortem:
+        serial full warmup plausibly consumed the whole window).
+        """
         def drain():
             while (any(s is not None for s in self.slot_req) or self.pending
                    or self._chunk is not None):
                 self.step()
+
+        horizon = max(1, self.serving.decode_horizon)
+        if scope == "bench":
+            nb = min(self.serving.max_prefill_batch, self.num_slots)
+            rs = [Request(prompt_ids=[0] * 4, max_tokens=1, ignore_eos=True)
+                  for _ in range(max(1, nb))]
+            for r in rs:
+                self.submit(r)
+            drain()
+            if horizon > 1:
+                self.cache, _, _ = decode_steps(
+                    self.cfg, horizon, self.params, self.cache,
+                    jnp.asarray(self.last_token), jnp.asarray(self.lengths),
+                    self._next_rng(), jnp.asarray(self.temps),
+                    jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
+                    mesh=self.mesh, impl=self.serving.attention_impl)
+            return
 
         # Distinct token values per warmup request — identical prompts would
         # prefix-cache-match each other and warm the WRONG program.
@@ -1250,16 +1291,43 @@ class Engine:
         # program): the first penalized request must not pay a 20-40s XLA
         # compile inside step(), freezing every in-flight stream (and
         # burning most of the /health stall budget).
-        horizon = max(1, self.serving.decode_horizon)
         if horizon > 1:
             r = Request(prompt_ids=[0] * 4, max_tokens=horizon + 1,
                         ignore_eos=True)
             self.submit(r)
             drain()
-        self.submit(Request(prompt_ids=[1] * 4,
-                            max_tokens=max(2, horizon + 1), ignore_eos=True,
-                            presence_penalty=0.01))
+        # Penalties variants compile against THROWAWAY buffers so warmup does
+        # not permanently allocate the [num_slots, vocab] counts array (~78 MB
+        # int32 at Qwen3 vocab x 128 slots) an engine whose clients never use
+        # penalties would otherwise carry — self.counts stays None until the
+        # first real penalized request (ADVICE r2). Both device calls donate
+        # their counts input, so the scratch buffer is freed on return.
+        cnts = jnp.zeros((self.num_slots, self.cfg.vocab_size), jnp.int32)
+        cnts = _reset_count_row(cnts, jnp.int32(0), jnp.int32(0))
+        self.cache, _, _ = decode_steps(
+            self.cfg, horizon, self.params, self.cache,
+            jnp.asarray(self.last_token), jnp.asarray(self.lengths),
+            self._next_rng(), jnp.asarray(self.temps),
+            jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
+            mesh=self.mesh, impl=self.serving.attention_impl,
+            counts=cnts, presence=jnp.asarray(self.pres_pens),
+            frequency=jnp.asarray(self.freq_pens), penalties=True)
+        del cnts
+        # Logprobs program variants ('logprobs' is a static arg on every step
+        # fn — distinct programs): one isolated request compiles the
+        # single-prefill + fused-decode logprob programs, one burst compiles
+        # the batched-prefill logprob program. Without these, the first
+        # logprobs=N request pays the same all-streams XLA freeze the
+        # penalties warmup exists to prevent (ADVICE r2, medium).
+        self.submit(Request(prompt_ids=[3] * 4, max_tokens=max(2, horizon + 1),
+                            ignore_eos=True, logprobs=0))
         drain()
+        if nb > 1:
+            rs = [Request(prompt_ids=[5] * 4, max_tokens=1, ignore_eos=True,
+                          logprobs=0) for _ in range(nb)]
+            for r in rs:
+                self.submit(r)
+            drain()
         # The horizon=1 decode variant (selected whenever a prefill is
         # possible) is a distinct compiled program (n_steps is static);
         # compile it now so the first decode overlapping a queued request

@@ -159,6 +159,17 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, optimizer,
             "(capacity_factor=%s; overflow tokens fall back to the residual "
             "stream)", cfg.moe_capacity_factor)
         cfg = cfg.scaled(moe_impl="gshard")
+    if seq_parallel and cfg.sliding_window > 0:
+        # Ring attention is full-causal: training a sliding-window model
+        # (Mistral) with sp > 1 would silently compute the wrong mask. The
+        # serving engine raises for the same sp+window combination — mirror
+        # that guard here instead of producing quietly-wrong gradients
+        # (ADVICE r2, medium).
+        raise ValueError(
+            "seq_parallel training does not compose with sliding-window "
+            "attention: ring attention ignores cfg.sliding_window "
+            f"({cfg.sliding_window}); train with seq_parallel=False or use "
+            "full attention")
     attend = make_ring_attend(mesh) if seq_parallel else None
     data_sharding = NamedSharding(mesh, tokens_pspec(seq_sharded=seq_parallel))
 

@@ -1,0 +1,297 @@
+"""Paged KV cache foundation tests: allocator semantics + physical-layout
+parity of every paged writer/kernel against its dense counterpart.
+
+The dense slot-contiguous cache IS a paged cache with an identity block table
+(serving/kv_cache.py docstring), so parity is exact: scatter a dense cache's
+pages into the pool in a PERMUTED order, run the paged op with the matching
+table, and the logical results must agree bit-for-bit (fp32 tolerance for the
+flash kernels). This pins the only thing the paged path changes — physical
+addressing — independently of the engine integration (VERDICT r2 missing #2 /
+next #3: the vLLM-style on-demand block capability, SURVEY.md §2.2 row 1).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
+from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
+from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
+from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention as pa
+from aws_k8s_ansible_provisioner_tpu.ops.attention import decode_attend
+
+CFG = ModelConfig(name="tiny", vocab_size=64, hidden_size=32, num_layers=2,
+                  num_heads=4, num_kv_heads=2, head_dim=16,
+                  intermediate_size=64, max_seq_len=256)
+PS = 8          # page size
+B = 3           # slots
+SV = 64         # virtual window per slot (8 logical pages)
+PPS = SV // PS
+
+
+def _identity_layout(quant=False, seed=0, perm_seed=None):
+    """Build a dense cache with random content and mirror it into a pool
+    under a (optionally permuted) block table. Returns (dense, pool, table)."""
+    rng = np.random.default_rng(seed)
+    dense = kvc.init_cache(CFG, B, SV, dtype=jnp.float32, quant=quant)
+    filled = {}
+    for name, arr in dense.items():
+        if arr.dtype == jnp.int8:
+            filled[name] = jnp.asarray(
+                rng.integers(-127, 128, arr.shape, dtype=np.int8))
+        else:
+            filled[name] = jnp.asarray(
+                rng.standard_normal(arr.shape), arr.dtype)
+    dense = filled
+    n_pages = B * PPS + 1                           # +1 scratch
+    order = np.arange(1, n_pages)
+    if perm_seed is not None:
+        np.random.default_rng(perm_seed).shuffle(order)
+    table = order.reshape(B, PPS).astype(np.int32)
+    pool = {}
+    for name, arr in dense.items():
+        # dense [L, B, Hkv, SV, (D)] -> logical pages [L, B*PPS, Hkv, PS, (D)]
+        L, _, H = arr.shape[:3]
+        tail = arr.shape[4:]
+        lp = arr.reshape(L, B, H, PPS, PS, *tail)
+        # page index of (slot b, logical page p) is b*PPS + p
+        lp = jnp.moveaxis(lp, 3, 2).reshape(L, B * PPS, H, PS, *tail)
+        buf = jnp.zeros((L, n_pages, H, PS) + tail, arr.dtype)
+        pool[name] = buf.at[:, table.reshape(-1)].set(lp)
+    return dense, pool, jnp.asarray(table)
+
+
+# ---------------------------------------------------------------------------
+# Allocator
+# ---------------------------------------------------------------------------
+
+
+def test_alloc_release_roundtrip():
+    p = pkv.PagePool(9, PS, first_page=1)
+    assert p.free_pages == 8
+    got = p.alloc(3)
+    assert len(got) == 3 and 0 not in got
+    assert p.free_pages == 5 and p.pages_in_use == 3
+    p.release_all(got)
+    assert p.free_pages == 8
+
+
+def test_alloc_exhaustion_returns_none():
+    p = pkv.PagePool(5, PS, first_page=1)
+    assert p.alloc(5) is None
+    got = p.alloc(4)
+    assert got is not None and p.alloc(1) is None
+
+
+def test_refcount_sharing():
+    p = pkv.PagePool(5, PS, first_page=1)
+    [pid] = p.alloc(1)
+    p.retain(pid)
+    p.release(pid)
+    assert p.pages_in_use == 1          # still held by the second ref
+    p.release(pid)
+    assert p.pages_in_use == 0
+
+
+def test_prefix_chain_lookup_and_eviction():
+    p = pkv.PagePool(7, PS, first_page=1)
+    prompt = list(range(20))            # 2 full pages + tail of 4
+    pages = p.alloc(3)
+    key = None
+    for i in range(2):                  # index the full pages
+        key = p.index_page(pages[i], key, tuple(prompt[i * PS:(i + 1) * PS]))
+    hit, n = p.lookup_prefix(prompt)
+    assert hit == pages[:2] and n == 2 * PS
+    # a different prompt sharing only page 0 matches one page
+    other = prompt[:PS] + [99] * PS
+    hit2, n2 = p.lookup_prefix(other)
+    assert hit2 == pages[:1] and n2 == PS
+    # release -> pages become evictable, still hit
+    p.release_all(pages)
+    assert p.free_pages == 6            # 3 free + 2 evictable + tail freed
+    hit3, n3 = p.lookup_prefix(prompt)
+    assert hit3 == hit and n3 == 2 * PS
+    # retaining an evictable page revives it
+    for pid in hit3:
+        p.retain(pid)
+    assert p.pages_in_use == 2
+    p.release_all(hit3)
+    # exhausting the pool reclaims evictable pages LRU-first and drops index
+    got = p.alloc(6)
+    assert got is not None
+    assert p.lookup_prefix(prompt)[1] == 0
+
+
+def test_scratch_page_reserved():
+    p = pkv.PagePool(4, PS, first_page=1)
+    got = p.alloc(3)
+    assert 0 not in got and p.alloc(1) is None
+
+
+# ---------------------------------------------------------------------------
+# Writer parity (XLA paths)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_write_prompt_parity(quant):
+    dense, pool, table = _identity_layout(quant=quant, perm_seed=7)
+    T = 19
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, T, 2, 16))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, T, 2, 16))
+    slot = 1
+    d1 = kvc.write_prompt({n: a[0] for n, a in dense.items()},
+                          jnp.int32(slot), k, v)
+    p1 = pkv.write_prompt_paged({n: a[0] for n, a in pool.items()},
+                                table[slot], k, v, PS)
+    got = {n: a[None] for n, a in p1.items()}
+    gathered = pkv.gather_dense(got, table[None, slot], PS)
+    for name in d1:
+        np.testing.assert_array_equal(
+            np.asarray(gathered[name][0, 0]), np.asarray(d1[name][slot]),
+            err_msg=name)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_write_prompts_batched_parity(quant):
+    dense, pool, table = _identity_layout(quant=quant, perm_seed=3)
+    N, T = 2, 11
+    k = jax.random.normal(jax.random.PRNGKey(3), (N + 1, T, 2, 16))
+    v = jax.random.normal(jax.random.PRNGKey(4), (N + 1, T, 2, 16))
+    slots = jnp.array([2, 0, B], jnp.int32)        # last row = padding (dense
+    # drops OOB slot; paged mirrors with an all-OOB_PAGE table row — NOT -1,
+    # which jnp scatters would wrap to the pool's last page)
+    tables = jnp.concatenate([table[jnp.array([2, 0])],
+                              jnp.full((1, PPS), pkv.OOB_PAGE, jnp.int32)])
+    d1 = kvc.write_prompts({n: a[0] for n, a in dense.items()}, slots, k, v)
+    p1 = pkv.write_prompts_paged({n: a[0] for n, a in pool.items()},
+                                 tables, k, v, PS)
+    gathered = pkv.gather_dense({n: a[None] for n, a in p1.items()},
+                                table, PS)
+    for name in d1:
+        np.testing.assert_array_equal(
+            np.asarray(gathered[name][0]), np.asarray(d1[name]),
+            err_msg=name)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_write_chunk_parity(quant):
+    dense, pool, table = _identity_layout(quant=quant, perm_seed=5)
+    C, start, slot = 12, 10, 2
+    k = jax.random.normal(jax.random.PRNGKey(5), (1, C, 2, 16))
+    v = jax.random.normal(jax.random.PRNGKey(6), (1, C, 2, 16))
+    d1 = kvc.write_chunk({n: a[0] for n, a in dense.items()},
+                         jnp.int32(slot), jnp.int32(start), k, v)
+    p1 = pkv.write_chunk_paged({n: a[0] for n, a in pool.items()},
+                               table[slot], jnp.int32(start), k, v, PS)
+    gathered = pkv.gather_dense({n: a[None] for n, a in p1.items()},
+                                table, PS)
+    for name in d1:
+        np.testing.assert_array_equal(
+            np.asarray(gathered[name][0]), np.asarray(d1[name]),
+            err_msg=name)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_write_token_layer_parity(quant):
+    dense, pool, table = _identity_layout(quant=quant, perm_seed=11)
+    lengths = jnp.array([5, SV - 1, 23], jnp.int32)
+    k = jax.random.normal(jax.random.PRNGKey(7), (B, 1, 2, 16))
+    v = jax.random.normal(jax.random.PRNGKey(8), (B, 1, 2, 16))
+    layer = jnp.int32(1)
+    d1 = kvc.write_token_layer(dense, layer, lengths, k, v)
+    p1 = pkv.write_token_layer_paged(pool, layer, lengths, table, k, v, PS)
+    gathered = pkv.gather_dense(p1, table, PS)
+    for name in d1:
+        np.testing.assert_array_equal(np.asarray(gathered[name]),
+                                      np.asarray(d1[name]), err_msg=name)
+
+
+def test_write_token_out_of_range_drops():
+    _, pool, table = _identity_layout(perm_seed=2)
+    before = {n: np.asarray(a) for n, a in pool.items()}
+    k = jnp.ones((B, 1, 2, 16))
+    lengths = jnp.array([SV, SV + 5, -1], jnp.int32)   # all out of window
+    p1 = pkv.write_token_layer_paged(pool, jnp.int32(0), lengths, table,
+                                     k, k, PS)
+    for name in before:
+        np.testing.assert_array_equal(np.asarray(p1[name]), before[name])
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel parity (interpret mode) — permuted physical layout
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_paged_decode_kernel_parity(quant):
+    dense, pool, table = _identity_layout(quant=quant, perm_seed=13)
+    Hq, D = 4, 16
+    q = jax.random.normal(jax.random.PRNGKey(9), (B, 1, Hq, D))
+    lengths = jnp.array([1, SV, 29], jnp.int32)
+    layer = jnp.int32(1)
+    kw = dict(cache_ks=dense["ks"], cache_vs=dense["vs"]) if quant else {}
+    ref = pa.decode_attend_pallas_layer(q, dense["k"], dense["v"], lengths,
+                                        layer, chunk=PS, interpret=True, **kw)
+    pkw = dict(pool_ks=pool["ks"], pool_vs=pool["vs"]) if quant else {}
+    out = pa.decode_attend_pallas_paged(q, pool["k"], pool["v"], lengths,
+                                        layer, table, interpret=True, **pkw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_kernel_sliding_window():
+    dense, pool, table = _identity_layout(perm_seed=17)
+    q = jax.random.normal(jax.random.PRNGKey(10), (B, 1, 4, 16))
+    lengths = jnp.array([7, SV, 40], jnp.int32)
+    W = 16
+    ref = pa.decode_attend_pallas_layer(q, dense["k"], dense["v"], lengths,
+                                        jnp.int32(0), chunk=PS,
+                                        interpret=True, window=W)
+    out = pa.decode_attend_pallas_paged(q, pool["k"], pool["v"], lengths,
+                                        jnp.int32(0), table, interpret=True,
+                                        window=W)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_paged_write_row_kernel_parity(quant):
+    dense, pool, table = _identity_layout(quant=quant, perm_seed=19)
+    new = jax.random.normal(jax.random.PRNGKey(11), (B, 2, 16))
+    rows = jnp.array([0, 33, SV + 2], jnp.int32)   # last drops
+    layer = jnp.int32(1)
+    if quant:
+        dk, dks = pa.cache_write_row_quant(dense["k"], dense["ks"], new, rows,
+                                           layer, interpret=True)
+        pk, pks = pa.cache_write_row_quant_paged(pool["k"], pool["ks"], new,
+                                                 rows, table, layer,
+                                                 interpret=True)
+        got = pkv.gather_dense({"k": pk, "ks": pks}, table, PS)
+        np.testing.assert_array_equal(np.asarray(got["k"]), np.asarray(dk))
+        np.testing.assert_array_equal(np.asarray(got["ks"]), np.asarray(dks))
+    else:
+        dk = pa.cache_write_row(dense["k"], new, rows, layer, interpret=True)
+        pk = pa.cache_write_row_paged(pool["k"], new, rows, table, layer,
+                                      interpret=True)
+        got = pkv.gather_dense({"k": pk}, table, PS)
+        np.testing.assert_array_equal(np.asarray(got["k"]), np.asarray(dk))
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_paged_spec_kernel_parity(quant):
+    dense, pool, table = _identity_layout(quant=quant, perm_seed=23)
+    R, Hq, D = 3, 4, 16
+    q = jax.random.normal(jax.random.PRNGKey(12), (B, R, Hq, D))
+    lengths = jnp.array([2, 17, SV - R - 1], jnp.int32)
+    layer = jnp.int32(0)
+    kw = dict(cache_ks=dense["ks"], cache_vs=dense["vs"]) if quant else {}
+    ref = pa.decode_attend_pallas_spec(q, dense["k"], dense["v"], lengths,
+                                       layer, chunk=PS, interpret=True, **kw)
+    pkw = dict(pool_ks=pool["ks"], pool_vs=pool["vs"]) if quant else {}
+    out = pa.decode_attend_pallas_spec_paged(q, pool["k"], pool["v"], lengths,
+                                             layer, table, interpret=True,
+                                             **pkw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
