@@ -605,6 +605,21 @@ class ServingConfig:
     decode_horizon: int = 8
     # Paged KV cache geometry.
     page_size: int = 64
+    # True paged KV (vLLM's on-demand block allocation; serving/paged_kv.py):
+    # a shared physical page pool + per-slot block tables replace the
+    # slot-contiguous per-slot reservation, so HBM cost tracks ACTUAL
+    # sequence lengths and admission is gated by free pages, not free slots.
+    # Single-device path — the dp/tp/sp mesh serves the dense layout (a
+    # per-dp-group pool is future work); the engine picks automatically.
+    paged: bool = True
+    # Physical pages in the pool. 0 = max_decode_slots * ceil(max_cache_len /
+    # page_size) — the same HBM as the dense cache, useful as a drop-in.
+    # Sizing it SMALLER is the point of paging: e.g. 4x the slots of a dense
+    # config with the same pool lets 4x the concurrent short requests share
+    # the HBM that dense sizing reserves for worst-case windows; when the
+    # pool runs dry mid-decode the engine preempts the newest request
+    # (vLLM-style recompute) rather than failing.
+    kv_pool_pages: int = 0
     # Batched prefill: up to this many queued prompts share one prefill
     # dispatch (rounded to a power-of-two row count so XLA compiles a fixed
     # set of programs). Under a burst, TTFT p50 scales with ceil(N/batch)
@@ -747,8 +762,28 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--ansible-vars", action="store_true",
                    help="emit deploy-layer vars as YAML")
+    p.add_argument("--render-manifest", metavar="PATH",
+                   help="render a deploy/ Jinja manifest with the config "
+                        "vars (the kind rehearsal uses this — the SAME "
+                        "single config source the playbooks consume)")
+    p.add_argument("--set", action="append", default=[], metavar="K=V",
+                   help="override a var for --render-manifest")
     args = p.parse_args()
-    if args.ansible_vars:
+    if args.render_manifest:
+        import jinja2
+        import yaml as _yaml
+
+        vars_ = _yaml.safe_load(ansible_vars())
+        for kv in args.set:
+            k, _, v = kv.partition("=")
+            try:
+                vars_[k] = json.loads(v)
+            except (ValueError, TypeError):
+                vars_[k] = v
+        env = jinja2.Environment(undefined=jinja2.StrictUndefined)
+        with open(args.render_manifest) as f:
+            print(env.from_string(f.read()).render(**vars_))
+    elif args.ansible_vars:
         print(ansible_vars(), end="")
     else:
         print(json.dumps(dataclasses.asdict(FrameworkConfig()), indent=2, default=str))

@@ -535,7 +535,9 @@ def make_prefill_attend_paged(pages: jnp.ndarray, seq_len: jnp.ndarray,
 def make_prefill_attend_batch_paged(tables: jnp.ndarray,
                                     seq_lens: jnp.ndarray, window: int = 0):
     """Paged batched prefill: N prompts scattered to their pages in one
-    dispatch. Padding rows carry all -1 tables (writes drop)."""
+    dispatch. Padding rows carry all-OOB_PAGE tables (writes drop) — NEVER
+    -1, which jnp scatters wrap to the pool's last physical page
+    (paged_kv.OOB_PAGE's contract)."""
     from aws_k8s_ansible_provisioner_tpu.models.layers import causal_attend
 
     def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:

@@ -20,6 +20,20 @@ import jax.numpy as jnp
 MAX_TOPK = 64
 
 
+def per_slot_keys(seeds: jnp.ndarray, ctrs: jnp.ndarray) -> jax.Array:
+    """[B] typed PRNG keys from per-slot (seed, position) pairs.
+
+    ``fold_in(key(seed_b), ctr_b)`` makes each draw a pure function of the
+    request's seed and its token position — NOT of batch composition, rng
+    chain history, or scheduling order. That is what the OpenAI ``seed``
+    parameter requires (same seed + same prompt => same sampled stream, even
+    across restarts and preemption resumes) and what a per-batch key can
+    never give. seeds: [B] uint32; ctrs: [B] int32.
+    """
+    return jax.vmap(lambda s, c: jax.random.fold_in(jax.random.key(s), c))(
+        seeds, ctrs)
+
+
 def apply_penalties(logits: jnp.ndarray, counts: jnp.ndarray,
                     presence: jnp.ndarray,
                     frequency: jnp.ndarray) -> jnp.ndarray:
@@ -38,12 +52,17 @@ def apply_penalties(logits: jnp.ndarray, counts: jnp.ndarray,
 
 def sample(
     logits: jnp.ndarray,       # [B, V] float
-    rng: jax.Array,
+    rng: jax.Array,            # one key for the batch, OR [B] per-slot keys
     temperature: jnp.ndarray,  # [B] float; 0 => greedy
     top_k: jnp.ndarray,        # [B] int; 0 => disabled (use all MAX_TOPK)
     top_p: jnp.ndarray,        # [B] float; 1.0 => disabled
 ) -> jnp.ndarray:
-    """Return sampled token ids [B] (int32)."""
+    """Return sampled token ids [B] (int32).
+
+    ``rng`` may be a single key (legacy batch draw) or a [B] vector of typed
+    keys from :func:`per_slot_keys` — the engine's seeded path, where each
+    slot's draw is independent of the others' presence.
+    """
     B, V = logits.shape
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -63,6 +82,11 @@ def sample(
     keep = keep.at[:, 0].set(True)
     vals = jnp.where(keep, vals, -jnp.inf)
 
-    draw = jax.random.categorical(rng, vals / safe_t, axis=-1)  # [B] in [0,K)
+    scaled = vals / safe_t
+    if jnp.ndim(rng) == 1 and jax.dtypes.issubdtype(rng.dtype,
+                                                    jax.dtypes.prng_key):
+        draw = jax.vmap(jax.random.categorical)(rng, scaled)    # per-slot
+    else:
+        draw = jax.random.categorical(rng, scaled, axis=-1)     # [B] in [0,K)
     sampled = jnp.take_along_axis(idxs, draw[:, None], axis=1)[:, 0].astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -74,11 +74,23 @@ def _load_lib() -> Optional[ctypes.CDLL]:
             lib.ts_submit.restype = ctypes.c_int32
             lib.ts_submit.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                       ctypes.c_int32, ctypes.c_int32]
+            if not hasattr(lib, "ts_submit_front"):
+                return None   # stale pre-paged build: rebuild native/
+            lib.ts_submit_front.restype = ctypes.c_int32
+            lib.ts_submit_front.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                            ctypes.c_int32, ctypes.c_int32]
             lib.ts_cancel.restype = ctypes.c_int32
             lib.ts_cancel.argtypes = [ctypes.c_void_p, ctypes.c_int64]
             lib.ts_pop_admission.restype = ctypes.c_int32
             lib.ts_pop_admission.argtypes = [
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32)]
+            lib.ts_pop_admission_paged.restype = ctypes.c_int32
+            lib.ts_pop_admission_paged.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_int32)]
@@ -127,17 +139,29 @@ class NativeScheduler:
         return self._lib.ts_submit(self._rt, req_id, prompt_len,
                                    max_tokens) == 0
 
+    def submit_front(self, req_id: int, prompt_len: int,
+                     max_tokens: int) -> bool:
+        return self._lib.ts_submit_front(self._rt, req_id, prompt_len,
+                                         max_tokens) == 0
+
     def cancel(self, req_id: int) -> int:
         return self._lib.ts_cancel(self._rt, req_id)
 
-    def pop_admission(self) -> Optional[Tuple]:
+    def pop_admission(self, free_pages: Optional[int] = None) -> Optional[Tuple]:
+        """``free_pages`` gates the head request by its worst-case page need
+        (paged-KV admission); None = dense admission (slots only)."""
         rid = ctypes.c_int64(-1)
         slot = ctypes.c_int32(-1)
         cid = ctypes.c_int64(-1)
         ncan = ctypes.c_int32(0)
-        got = self._lib.ts_pop_admission(
-            self._rt, ctypes.byref(rid), ctypes.byref(slot),
-            ctypes.byref(cid), ctypes.byref(ncan))
+        if free_pages is None:
+            got = self._lib.ts_pop_admission(
+                self._rt, ctypes.byref(rid), ctypes.byref(slot),
+                ctypes.byref(cid), ctypes.byref(ncan))
+        else:
+            got = self._lib.ts_pop_admission_paged(
+                self._rt, free_pages, ctypes.byref(rid), ctypes.byref(slot),
+                ctypes.byref(cid), ctypes.byref(ncan))
         if ncan.value:
             return ("cancelled", cid.value)
         if got:
@@ -195,6 +219,15 @@ class PyScheduler:
             self._queue.append((req_id, prompt_len, max_tokens))
         return True
 
+    def submit_front(self, req_id: int, prompt_len: int,
+                     max_tokens: int) -> bool:
+        """Front-of-queue submit: paged-KV preemption resume (see runtime.h)."""
+        if prompt_len < 0 or prompt_len + 1 > self.max_len:
+            return False
+        with self._lock:
+            self._queue.appendleft((req_id, prompt_len, max_tokens))
+        return True
+
     def cancel(self, req_id: int) -> int:
         with self._lock:
             if any(r == req_id for r, _, _ in self._queue):
@@ -206,7 +239,11 @@ class PyScheduler:
                     return 2
         return 0
 
-    def pop_admission(self) -> Optional[Tuple]:
+    def pop_admission(self, free_pages: Optional[int] = None) -> Optional[Tuple]:
+        """``free_pages`` gates the head request by its worst-case page need
+        ceil((prompt_len + 1) / page_size) — paged-KV admission; None = dense
+        (slots-only). Head-of-line blocking is deliberate: FCFS fairness, the
+        vLLM scheduler's behavior."""
         with self._lock:
             free = self._free[0] if self._free else None
             while self._queue:
@@ -218,6 +255,10 @@ class PyScheduler:
                     return ("cancelled", rid)
                 if free is None:
                     return None
+                if free_pages is not None:
+                    needed = -(-(plen + 1) // self.page_size)
+                    if needed > free_pages:
+                        return None
                 self._queue.popleft()
                 self._free.popleft()
                 self._slot_req[free] = rid

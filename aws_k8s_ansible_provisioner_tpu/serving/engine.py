@@ -46,12 +46,18 @@ from aws_k8s_ansible_provisioner_tpu.models.layers import (
 )
 from aws_k8s_ansible_provisioner_tpu.ops.attention import (
     make_chunk_prefill_attend,
+    make_chunk_prefill_attend_paged,
     make_decode_attend_carry,
+    make_decode_attend_carry_paged,
     make_prefill_attend,
     make_prefill_attend_batch,
+    make_prefill_attend_batch_paged,
+    make_prefill_attend_paged,
     make_spec_attend_carry,
+    make_spec_attend_carry_paged,
 )
 from aws_k8s_ansible_provisioner_tpu.ops.sampling import (apply_penalties,
+                                                           per_slot_keys,
                                                            sample)
 from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import EngineMetrics
@@ -95,6 +101,13 @@ class Request:
     # completions logprobs=0 semantics; capped at LOGPROB_K). Any non-None
     # value switches the slot's dispatches to the logprob program variants.
     logprobs: object = None
+    # OpenAI ``seed``: deterministic sampling for this request — same seed +
+    # same prompt + same sampling params => same token stream, independent of
+    # batch composition (ops/sampling.per_slot_keys). None = a per-engine
+    # derived seed (sampling still randomized across requests).
+    seed: Optional[int] = None
+    # resolved at submit(): seed, or the engine's derived default
+    eff_seed: int = 0
     id: int = field(default_factory=lambda: next(_REQUEST_IDS))
     # Filled in by the engine:
     generated: List[int] = field(default_factory=list)
@@ -162,22 +175,45 @@ def _reset_count_row(counts, slot, token):
     return counts.at[slot, token].add(1)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _restore_count_row(counts, slot, row):
+    """Overwrite one slot's counts row with a precomputed [V] histogram —
+    restores a preempted request's penalty state on resume (its prior
+    generated tokens are re-prefilled as CONTEXT, but penalties count them
+    as GENERATED; without this the penalty would forget everything before
+    the preemption)."""
+    return jax.lax.dynamic_update_slice(
+        counts, row[None].astype(counts.dtype), (slot, jnp.int32(0)))
+
+
 @partial(jax.jit, static_argnums=(0,), static_argnames=("logprobs",),
          donate_argnums=(2,))
 def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
-                 temperature, top_k, top_p, logprobs: bool = False):
+                 temperature, top_k, top_p, logprobs: bool = False,
+                 pages=None, seed=None):
     """Prefill one prompt into one slot; returns (cache, first sampled token).
 
     tokens: [1, T] right-padded to a bucket; true_len: scalar valid length;
-    slot: scalar slot index.
+    slot: scalar slot index. With ``pages`` ([max_pages] int32) the cache is
+    the paged pool and rows scatter through the slot's block table
+    (serving/paged_kv.py) — ``slot`` is then unused by the writer.
     """
     T = tokens.shape[1]
     positions = jnp.arange(T, dtype=jnp.int32)[None, :]
-    attend = make_prefill_attend(slot, true_len,
-                                 window=cfg.sliding_window)
+    if pages is not None:
+        attend = make_prefill_attend_paged(pages, true_len,
+                                           window=cfg.sliding_window)
+    else:
+        attend = make_prefill_attend(slot, true_len,
+                                     window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = jnp.take(logits[0], true_len - 1, axis=0)       # [V]
-    token = sample(last[None, :], rng, temperature[None], top_k[None],
+    # Per-request seeded draw: key = (seed, position), so the stream is
+    # reproducible across restarts/preemption (OpenAI `seed`). ``rng`` is
+    # the legacy fallback when no seed rides the dispatch.
+    keys = per_slot_keys(seed[None], true_len[None]) if seed is not None \
+        else rng
+    token = sample(last[None, :], keys, temperature[None], top_k[None],
                    top_p[None])[0]
     if logprobs:
         return cache, token, _logprob_topk(last[None, :], token[None])
@@ -188,7 +224,7 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
          donate_argnums=(2,))
 def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
                        slots, rng, temperature, top_k, top_p,
-                       logprobs: bool = False):
+                       logprobs: bool = False, tables=None, seeds=None):
     """Prefill N prompts into N slots in ONE dispatch.
 
     tokens: [N, T] right-padded to a (row, length) bucket; true_lens/slots/
@@ -196,15 +232,21 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
     cache writes drop) — the host ignores their sampled tokens. Returns
     (cache, first tokens [N]). One program per (N-bucket, T-bucket) pair;
     under a burst this turns N serialized prefill dispatches into
-    ceil(N/batch) (VERDICT r1 missing #4).
+    ceil(N/batch) (VERDICT r1 missing #4). With ``tables`` ([N, max_pages]
+    int32; padding rows all OOB_PAGE) rows scatter through the paged pool.
     """
     N, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (N, T))
-    attend = make_prefill_attend_batch(slots, true_lens,
-                                       window=cfg.sliding_window)
+    if tables is not None:
+        attend = make_prefill_attend_batch_paged(tables, true_lens,
+                                                 window=cfg.sliding_window)
+    else:
+        attend = make_prefill_attend_batch(slots, true_lens,
+                                           window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = logits[jnp.arange(N), true_lens - 1]            # [N, V]
-    toks = sample(last, rng, temperature, top_k, top_p)
+    keys = per_slot_keys(seeds, true_lens) if seeds is not None else rng
+    toks = sample(last, keys, temperature, top_k, top_p)
     if logprobs:
         return cache, toks, _logprob_topk(last, toks)
     return cache, toks
@@ -214,7 +256,7 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
          donate_argnums=(2,))
 def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
                        chunk_len, rng, temperature, top_k, top_p,
-                       logprobs: bool = False):
+                       logprobs: bool = False, pages=None, seed=None):
     """Prefill ONE chunk of a long prompt; decode interleaves between chunks.
 
     tokens: [1, C] (the chunk, right-padded on the final chunk); start: row
@@ -227,11 +269,21 @@ def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
     """
     C = tokens.shape[1]
     positions = start + jnp.arange(C, dtype=jnp.int32)[None, :]
-    attend = make_chunk_prefill_attend(slot, start,
-                                       window=cfg.sliding_window)
+    if pages is not None:
+        attend = make_chunk_prefill_attend_paged(pages, start,
+                                                 window=cfg.sliding_window)
+    else:
+        attend = make_chunk_prefill_attend(slot, start,
+                                           window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = jnp.take(logits[0], chunk_len - 1, axis=0)      # [V]
-    token = sample(last[None, :], rng, temperature[None], top_k[None],
+    # ctr = start + chunk_len = the full context length at the FINAL chunk
+    # (the only one whose sample survives) — matching what decode/prefill
+    # would use for the same position, so seeded streams are chunking-layout
+    # independent.
+    keys = per_slot_keys(seed[None], (start + chunk_len)[None]) \
+        if seed is not None else rng
+    token = sample(last[None, :], keys, temperature[None], top_k[None],
                    top_p[None])[0]
     if logprobs:
         return cache, token, _logprob_topk(last[None, :], token[None])
@@ -246,7 +298,7 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
                  lengths, rng, temperature, top_k, top_p, mesh=None,
                  impl: str = "auto", logprobs: bool = False,
                  counts=None, presence=None, frequency=None,
-                 penalties: bool = False):
+                 penalties: bool = False, table=None, seeds=None):
     """``n_steps`` fused decode steps for every slot, one device dispatch.
 
     tokens/lengths/sampling params: [B]. Returns (cache, out [n_steps, B]).
@@ -268,9 +320,14 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
         # Carry-path forward: the cache stays in place in the scan carry and
         # attention reads it layer-indexed — no per-layer xs→ys copy (the
         # copy cost dominated decode at ~24 ms/token on v5e; see
-        # model_forward_carry's docstring).
-        attend = make_decode_attend_carry(lens, impl=impl, mesh=mesh,
-                                          window=cfg.sliding_window)
+        # model_forward_carry's docstring). With a block ``table`` the cache
+        # is the paged pool and the kernels address pages through it.
+        if table is not None:
+            attend = make_decode_attend_carry_paged(
+                lens, table, impl=impl, window=cfg.sliding_window)
+        else:
+            attend = make_decode_attend_carry(lens, impl=impl, mesh=mesh,
+                                              window=cfg.sliding_window)
         logits, cache = model_forward_carry(params, cfg, tok[:, None],
                                             positions, cache, attend)
         step_logits = logits[:, 0, :]
@@ -280,7 +337,13 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
             # repeat is penalized immediately, not at the next dispatch)
             step_logits = apply_penalties(step_logits, cnts, presence,
                                           frequency)
-        nxt = sample(step_logits, rng_i, temperature, top_k, top_p)
+        # ctr = lens + 1 = the context length this draw extends TO: distinct
+        # from the prefill draw's ctr (= prompt length) and equal to what a
+        # preemption-resume prefill of the same position would use — the
+        # seed contract's cross-resume reproducibility hangs on this
+        # alignment (review r3).
+        keys = per_slot_keys(seeds, lens + 1) if seeds is not None else rng_i
+        nxt = sample(step_logits, keys, temperature, top_k, top_p)
         if penalties:
             cnts = cnts.at[jnp.arange(cnts.shape[0]), nxt].add(1)
         if logprobs:
@@ -300,7 +363,7 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
          donate_argnums=(3,))
 def spec_decode_step(cfg: ModelConfig, R: int, params, cache, tokens,
                      lengths, rng, temperature, top_k, top_p,
-                     impl: str = "auto"):
+                     impl: str = "auto", table=None, seeds=None):
     """Speculative verify: R tokens per slot in ONE dispatch.
 
     tokens: [B, R] = [last accepted token, spec_k prompt-lookup drafts];
@@ -319,8 +382,12 @@ def spec_decode_step(cfg: ModelConfig, R: int, params, cache, tokens,
     """
     B = tokens.shape[0]
     positions = lengths[:, None] + jnp.arange(R, dtype=jnp.int32)[None, :]
-    attend = make_spec_attend_carry(lengths, impl=impl,
-                                    window=cfg.sliding_window)
+    if table is not None:
+        attend = make_spec_attend_carry_paged(lengths, table, impl=impl,
+                                              window=cfg.sliding_window)
+    else:
+        attend = make_spec_attend_carry(lengths, impl=impl,
+                                        window=cfg.sliding_window)
     logits, cache = model_forward_carry(params, cfg, tokens, positions,
                                         cache, attend)
     preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # [B, R]
@@ -329,7 +396,10 @@ def spec_decode_step(cfg: ModelConfig, R: int, params, cache, tokens,
     m = jnp.cumprod(match, axis=-1).sum(axis=-1)               # [B]
     greedy = temperature <= 0.0
     m = jnp.where(greedy, m, 0)
-    sampled0 = sample(logits[:, 0], rng, temperature, top_k, top_p)
+    # same ctr convention as decode_steps: this draw extends the context to
+    # lengths + 1
+    keys = per_slot_keys(seeds, lengths + 1) if seeds is not None else rng
+    sampled0 = sample(logits[:, 0], keys, temperature, top_k, top_p)
     correction = jnp.where(greedy, preds[jnp.arange(B), m], sampled0)
     pos = jnp.arange(R - 1, dtype=jnp.int32)[None, :]
     out = jnp.where(pos < m[:, None], drafts, 0)
@@ -426,7 +496,47 @@ class Engine:
                     f"cache window {self.max_len} must split into 8-row-"
                     f"aligned sequence shards; not divisible by sp={sp} * 8")
             self.params = params = shard_params(params, self.mesh, cfg)
-        if self.mesh is not None:
+        # True paged KV (single-device): shared page pool + block tables; the
+        # mesh path keeps the dense slot-contiguous layout (per-dp-group
+        # pools are future work — see ServingConfig.paged).
+        self.paged = bool(serving.paged) and self.mesh is None
+        if self.paged:
+            from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
+
+            ps = serving.page_size
+            # the Pallas row-write kernels touch 8-row (bf16) / 32-row (int8)
+            # sub-blocks that must divide the page
+            align = 32 if self.kv_quant else 8
+            if ps % align:
+                raise ValueError(f"page_size={ps} must be a multiple of "
+                                 f"{align} for the "
+                                 f"{'int8' if self.kv_quant else 'bf16'} "
+                                 f"paged kernels")
+            self.pages_per_slot = -(-self.max_len // ps)
+            pool_pages = serving.kv_pool_pages \
+                or self.num_slots * self.pages_per_slot
+            if pool_pages < self.pages_per_slot:
+                # a lone max-length request must always be able to grow to
+                # the window, or preemption would spin on itself
+                raise ValueError(
+                    f"kv_pool_pages={pool_pages} < pages for one full "
+                    f"window ({self.pages_per_slot})")
+            # +1: physical page 0 is the SCRATCH page — every idle slot's
+            # table points at it, so the decode programs' per-slot garbage
+            # row writes can never land in a page another slot owns.
+            self.cache = pkv.init_pool(cfg, pool_pages + 1, ps, dtype,
+                                       quant=self.kv_quant)
+            self.allocator = pkv.PagePool(pool_pages + 1, ps, first_page=1)
+            self.table = np.zeros((self.num_slots, self.pages_per_slot),
+                                  np.int32)
+            self._slot_pages: List[List[int]] = [[] for _ in
+                                                 range(self.num_slots)]
+            # req id -> prompt+generated context for preemption resume
+            self._resume_ctx: dict = {}
+            # admission recency per slot: preemption victims are newest-first
+            self._admit_seq = np.zeros(self.num_slots, np.int64)
+            self._seq_counter = 0
+        elif self.mesh is not None:
             # Allocate the cache DIRECTLY sharded (jit with out_shardings):
             # each device materializes only its own shard. Building unsharded
             # and re-sharding with device_put would peak one device's HBM at
@@ -449,12 +559,20 @@ class Engine:
 
         self.metrics = EngineMetrics()
         self._rng = jax.random.PRNGKey(0)
+        # Derived sampling seeds for requests that don't set OpenAI `seed`:
+        # a per-engine deterministic stream, so identical submission
+        # sequences on two engines (the dryrun parity harness) draw
+        # identically — matching the old shared-rng-chain behavior.
+        import random as _random
+
+        self._py_rng = _random.Random(0)
         # Host-side slot state (numpy mirrors of the device vectors).
         self.lengths = np.zeros(self.num_slots, np.int32)
         self.last_token = np.zeros(self.num_slots, np.int32)
         self.temps = np.zeros(self.num_slots, np.float32)
         self.top_ks = np.zeros(self.num_slots, np.int32)
         self.top_ps = np.ones(self.num_slots, np.float32)
+        self.seeds = np.zeros(self.num_slots, np.uint32)
         self.pres_pens = np.zeros(self.num_slots, np.float32)
         self.freq_pens = np.zeros(self.num_slots, np.float32)
         # [num_slots, V] generated-token counts, allocated lazily on the
@@ -589,6 +707,157 @@ class Engine:
             return True
         return n >= max(1, self.serving.prefix_cache_payback_rows)
 
+    # -- paged-KV lifecycle -------------------------------------------------
+
+    def _paged_admit(self, req: Request, slot: int, isolated: bool):
+        """Assign pages to an admitted request: page-level prefix reuse
+        (hash-chain lookup, refcounted sharing — no row copies) + fresh
+        allocation for the tail. Returns (ids, reuse_off, resumed), or None
+        if the allocator cannot cover the tail right now (the caller
+        requeues; the admission gate makes this rare — it means evictable
+        pages vanished between the gate and here).
+
+        ``isolated`` mirrors the dense path's dispatch-economics gate: a
+        prefix hit forces the serialized chunk path, so under a burst the
+        batched prefill wins unless the request would chunk anyway.
+        """
+        ctx = self._resume_ctx.get(req.id)
+        resumed = ctx is not None
+        ids = list(ctx) if resumed else list(req.prompt_ids)
+        ps = self.serving.page_size
+        matched: List[int] = []
+        n = 0
+        if self.serving.prefix_cache and (isolated or resumed
+                                          or self._should_chunk(req)):
+            matched, n = self.allocator.lookup_prefix(ids)
+            # the final token must run through prefill to produce the first
+            # sampled token — cap reuse one token short of the prompt
+            while n > len(ids) - 1:
+                matched.pop()
+                n -= ps
+        for pid in matched:
+            self.allocator.retain(pid)
+        need = -(-len(ids) // ps) - len(matched)
+        fresh = self.allocator.alloc(need) if need > 0 else []
+        if fresh is None:
+            self.allocator.release_all(matched)
+            return None
+        self._resume_ctx.pop(req.id, None)
+        pages = matched + list(fresh)
+        self._slot_pages[slot] = pages
+        self.table[slot, :] = 0
+        self.table[slot, :len(pages)] = pages
+        self._seq_counter += 1
+        self._admit_seq[slot] = self._seq_counter
+        if n > 0:
+            self.metrics.prefix_cache_hits.inc()
+            self.metrics.prefix_tokens_reused.inc(n)
+        self._pages_gauges()
+        return ids, n, resumed
+
+    def _index_prompt_pages(self, slot: int, ids: List[int],
+                            n_valid: Optional[int] = None):
+        """Register the slot's FULL pages over ``ids`` in the allocator's
+        hash-chain index so later prompts (and preemption resumes) can share
+        them. Partial tail pages are never indexed — their rows past the
+        content are scratch garbage. ``n_valid`` caps indexing to pages whose
+        rows are all WRITTEN: at preemption the last generated token's K/V
+        row is still pending the next dispatch, so indexing past
+        len(ids) - 1 would publish a page with one garbage row to every
+        future prefix hit (review r3)."""
+        ps = self.serving.page_size
+        pages = self._slot_pages[slot]
+        n_valid = len(ids) if n_valid is None else n_valid
+        key = None
+        for p in range(min(n_valid // ps, len(pages))):
+            key = self.allocator.index_page(
+                pages[p], key, tuple(ids[p * ps:(p + 1) * ps]))
+
+    def _release_slot_pages(self, slot: int):
+        """Return a slot's pages to the allocator (indexed ones go to the
+        evictable LRU, still prefix-matchable) and point its table at the
+        scratch page — idle slots' garbage decode writes must never land in
+        pages another request now owns."""
+        if not self.paged:
+            return
+        self.allocator.release_all(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self.table[slot, :] = 0
+        self.lengths[slot] = 0
+        self._pages_gauges()
+
+    def _pages_gauges(self):
+        st = self.allocator.stats()
+        self.metrics.kv_pages_total.set(st["pages_total"])
+        self.metrics.kv_pages_in_use.set(st["pages_live"])
+
+    def _ensure_pages(self, new_rows: int) -> bool:
+        """Grow every active slot's page run to cover rows
+        [0, min(len + new_rows, window)) before a decode/spec dispatch — the
+        device cannot allocate, and surplus mid-horizon writes must land in
+        pages the slot OWNS (never scratch aliased with another slot's
+        table). When the pool runs dry, preempt the newest-admitted request
+        (vLLM-style recompute: pages freed, request resubmitted at the queue
+        front) until allocation succeeds. Returns whether any slot is still
+        active."""
+        if not self.paged:
+            return bool(self._active_slots())
+        ps = self.serving.page_size
+        # oldest first: under pressure the newest admissions yield their
+        # pages (and their slots) to the oldest — FCFS fairness
+        order = sorted(self._active_slots(), key=lambda s: self._admit_seq[s])
+        for slot in order:
+            if self.slot_req[slot] is None:   # preempted below this round
+                continue
+            rows = min(int(self.lengths[slot]) + new_rows,
+                       self.pages_per_slot * ps)
+            pages = self._slot_pages[slot]
+            while len(pages) < -(-rows // ps):
+                need = -(-rows // ps) - len(pages)
+                got = self.allocator.alloc(need)
+                if got is not None:
+                    self.table[slot, len(pages):len(pages) + need] = got
+                    pages.extend(got)
+                    break
+                # newest admission overall yields — when that is this slot
+                # itself (it is the youngest and still starving), it gets
+                # requeued rather than taking pages from older requests
+                victim = max(self._active_slots(), default=None,
+                             key=lambda s: self._admit_seq[s])
+                if victim is None:
+                    break
+                self._preempt(victim)
+                if victim == slot:
+                    break
+        self._pages_gauges()
+        return bool(self._active_slots())
+
+    def _preempt(self, slot: int):
+        """Reclaim a running request's pages; it resumes later by
+        re-prefilling prompt + generated-so-far (the full pages of that
+        context stay in the evictable index, so the resume usually hash-hits
+        everything but the tail). The vLLM scheduler's RECOMPUTE preemption,
+        paged-TPU edition."""
+        req = self.slot_req[slot]
+        ids = req.prompt_ids + req.generated
+        # make the resume a prefix hit — but only over fully-WRITTEN pages
+        # (the last generated token's row is pending the next dispatch)
+        self._index_prompt_pages(slot, ids, n_valid=len(ids) - 1)
+        self._resume_ctx[req.id] = ids
+        self.slot_req[slot] = None
+        self.temps[slot] = 0.0
+        self.pres_pens[slot] = 0.0
+        self.freq_pens[slot] = 0.0
+        self._release_slot_pages(slot)
+        self.sched.release(slot)
+        remaining = max(1, req.max_tokens - len(req.generated))
+        with self._lock:
+            self._queued[req.id] = req
+        self.sched.submit_front(req.id, len(ids), remaining)
+        self.metrics.preemptions.inc()
+        self.metrics.active_requests.set(len(self._active_slots()))
+        self.metrics.queue_depth.set(self.sched.stats().queue_depth)
+
     def submit(self, req: Request) -> Request:
         req.t_submit = time.monotonic()
         # A prompt that doesn't fit is an ERROR, not a truncation: serving the
@@ -601,6 +870,11 @@ class Engine:
         budget = self.max_len - len(req.prompt_ids) - 1
         if req.max_tokens > budget:
             req.max_tokens = max(1, budget)
+        # OpenAI `seed`: the request's own seed wins; otherwise a derived
+        # per-engine seed keeps unseeded sampling randomized across requests
+        # while identical submission orders stay reproducible.
+        req.eff_seed = (int(req.seed) & 0xffffffff) if req.seed is not None \
+            else self._py_rng.getrandbits(32)
         with self._lock:
             self._queued[req.id] = req
             self.sched.submit(req.id, len(req.prompt_ids), req.max_tokens)
@@ -672,12 +946,18 @@ class Engine:
         batch: List = []
         chunk_next = None
         while len(batch) < max(1, self.serving.max_prefill_batch):
-            action = self.sched.pop_admission()
+            # Paged admission is gated by the allocator's headroom (free +
+            # evictable pages) — capacity scales with ACTUAL lengths, the
+            # vLLM on-demand-block behavior (VERDICT r2 missing #2).
+            action = self.sched.pop_admission(
+                self.allocator.free_pages if self.paged else None)
             if action is None:
                 break
             if action[0] == "cancelled":
                 with self._lock:
                     cand = self._queued.pop(action[1], None)
+                if self.paged:
+                    self._resume_ctx.pop(action[1], None)
                 self.metrics.queue_depth.set(self.sched.stats().queue_depth)
                 if cand is not None:
                     cand.finish_reason = "cancelled"
@@ -689,6 +969,30 @@ class Engine:
             self.metrics.queue_depth.set(self.sched.stats().queue_depth)
             if req is None:  # should not happen; free the slot defensively
                 self.sched.release(slot)
+                continue
+            if self.paged:
+                isolated = (not batch
+                            and self.sched.stats().queue_depth == 0)
+                prep = self._paged_admit(req, slot, isolated)
+                if prep is None:
+                    # evictable pages vanished between the admission gate
+                    # and allocation (another admit this round took them):
+                    # requeue at the front and stop admitting this step
+                    self.sched.release(slot)
+                    with self._lock:
+                        self._queued[rid] = req
+                    ids_q = self._resume_ctx.get(rid, req.prompt_ids)
+                    self.sched.submit_front(
+                        rid, len(ids_q),
+                        max(1, req.max_tokens - len(req.generated)))
+                    break
+                ids, off, resumed = prep
+                # prefix reuse and resumes walk the chunk program from the
+                # reuse offset; fresh bucket-sized prompts join the batch
+                if off > 0 or resumed or self._should_chunk(req):
+                    chunk_next = (req, slot, ("paged", ids, off, resumed))
+                    break
+                batch.append((req, slot))
                 continue
             # Prefix reuse goes through the (serialized) chunk program, so
             # only consult the cache for an ISOLATED arrival — empty batch
@@ -721,16 +1025,18 @@ class Engine:
                     self._do_prefill_batch(batch)
             except Exception:
                 # Slots were assigned by the scheduler but slot_req[slot] is
-                # only set on success — release them and notify the clients
-                # here, or the capacity leaks and the waiters hang
-                # (run_forever's _fail_all can't see either).
+                # only set on success — release them (and their pages) and
+                # notify the clients here, or the capacity leaks and the
+                # waiters hang (run_forever's _fail_all can't see either).
                 for req, slot in batch:
+                    self._release_slot_pages(slot)
                     self.sched.release(slot)
                     req.finish_reason = "error"
                     self.metrics.mark_request("error", 0.0)
                     req.out_queue.put(None)
                 if chunk_next is not None:
                     req, slot, _ = chunk_next
+                    self._release_slot_pages(slot)
                     self.sched.release(slot)
                     req.finish_reason = "error"
                     self.metrics.mark_request("error", 0.0)
@@ -750,18 +1056,43 @@ class Engine:
             return True
         return False
 
-    def _activate(self, req: Request, slot: int, token: int, lp=None):
-        """Shared post-prefill bookkeeping: slot state + TTFT + first token."""
+    def _activate(self, req: Request, slot: int, token: int, lp=None,
+                  ids: Optional[List[int]] = None, resumed: bool = False):
+        """Shared post-prefill bookkeeping: slot state + TTFT + first token.
+
+        ``ids`` overrides the cache-resident token sequence when it differs
+        from the request prompt — a preemption resume re-prefills
+        prompt + generated-so-far, so lengths and page indexing must track
+        THAT sequence. A resume (``resumed``) is a pure CACHE REBUILD: the
+        prefill-sampled token is DISCARDED (prefill applies no penalties and
+        its draw position belongs to the already-emitted stream); the next
+        decode dispatch produces the continuation with penalties and the
+        seeded key it would have used without the preemption — bit-identical
+        streams either way."""
+        ids = list(req.prompt_ids) if ids is None else ids
         now = time.monotonic()
-        req.t_first_token = now
-        self.metrics.ttft.observe(now - req.t_submit)
-        self.metrics.prompt_tokens.inc(len(req.prompt_ids))
-        self._slot_tokens[slot] = tuple(req.prompt_ids)
+        if not req.t_first_token:     # don't re-observe on preemption resume
+            req.t_first_token = now
+            self.metrics.ttft.observe(now - req.t_submit)
+        if not resumed:
+            # a resume's context tokens were all counted at first admission
+            self.metrics.prompt_tokens.inc(len(ids))
+        if self.paged:
+            self._index_prompt_pages(slot, ids)
+        else:
+            self._slot_tokens[slot] = tuple(req.prompt_ids)
         self.slot_req[slot] = req
-        self.lengths[slot] = len(req.prompt_ids)
+        # Resume: decode's next dispatch RE-writes last_token's K/V at row
+        # ``lengths`` before attending, so point it at the last real token's
+        # own row (its recomputed K/V is identical) — lengths = len(ids)
+        # would duplicate that row at len(ids) and shift every later write,
+        # and the seeded draw counter (lens + 1) aligns with the
+        # unpreempted stream exactly at len(ids) - 1.
+        self.lengths[slot] = len(ids) - 1 if resumed else len(ids)
         self.temps[slot] = req.temperature
         self.top_ks[slot] = req.top_k
         self.top_ps[slot] = req.top_p
+        self.seeds[slot] = req.eff_seed
         self.pres_pens[slot] = req.presence_penalty
         self.freq_pens[slot] = req.frequency_penalty
         if req.presence_penalty or req.frequency_penalty:
@@ -771,15 +1102,28 @@ class Engine:
             if self.counts is None:
                 self.counts = jnp.zeros(
                     (self.num_slots, self.cfg.vocab_size), jnp.int32)
-            # zero the recycled slot's row, then count the first token
-            self.counts = _reset_count_row(self.counts, jnp.int32(slot),
-                                           jnp.int32(token))
-        self.sched.note_prefill(slot, len(req.prompt_ids))
+            if resumed:
+                # restore the full pre-preemption penalty state (the
+                # discarded prefill token contributes nothing)
+                row = np.bincount(np.asarray(req.generated, np.int64),
+                                  minlength=self.cfg.vocab_size)
+                self.counts = _restore_count_row(
+                    self.counts, jnp.int32(slot), jnp.asarray(row, jnp.int32))
+            else:
+                # zero the recycled slot's row, then count the first token
+                self.counts = _reset_count_row(self.counts, jnp.int32(slot),
+                                               jnp.int32(token))
+        self.sched.note_prefill(slot, int(self.lengths[slot]))
         self.metrics.active_requests.set(len(self._active_slots()))
-        self._emit(slot, token, lp)
+        if resumed:
+            # rebuild complete; decode continues from the last REAL token
+            self.last_token[slot] = ids[-1]
+        else:
+            self._emit(slot, token, lp)
 
     def _do_prefill(self, req: Request, slot: int):
-        self._slot_tokens[slot] = ()   # rows about to be overwritten
+        if not self.paged:
+            self._slot_tokens[slot] = ()   # rows about to be overwritten
         ids = req.prompt_ids
         bucket = self._bucket_for(len(ids))
         tokens = np.zeros((1, bucket), np.int32)
@@ -790,7 +1134,9 @@ class Engine:
             jnp.asarray(tokens), jnp.int32(len(ids)), jnp.int32(slot),
             self._next_rng(), jnp.float32(req.temperature),
             jnp.int32(req.top_k), jnp.float32(req.top_p),
-            logprobs=req.logprobs is not None)
+            logprobs=req.logprobs is not None,
+            pages=jnp.asarray(self.table[slot]) if self.paged else None,
+            seed=jnp.uint32(req.eff_seed))
         lp = None
         if req.logprobs is not None:
             self.cache, token, lp_t = out
@@ -815,8 +1161,10 @@ class Engine:
         temps = np.zeros(n_bucket, np.float32)
         top_ks = np.zeros(n_bucket, np.int32)
         top_ps = np.ones(n_bucket, np.float32)
+        seeds = np.zeros(n_bucket, np.uint32)
         for i, (req, slot) in enumerate(batch):
-            self._slot_tokens[slot] = ()   # rows about to be overwritten
+            if not self.paged:
+                self._slot_tokens[slot] = ()   # rows about to be overwritten
             ids = req.prompt_ids
             tokens[i, :len(ids)] = ids
             true_lens[i] = len(ids)
@@ -824,13 +1172,23 @@ class Engine:
             temps[i] = req.temperature
             top_ks[i] = req.top_k
             top_ps[i] = req.top_p
+            seeds[i] = req.eff_seed
+        tables = None
+        if self.paged:
+            from aws_k8s_ansible_provisioner_tpu.serving.paged_kv import (
+                OOB_PAGE)
+
+            tb = np.full((n_bucket, self.pages_per_slot), OOB_PAGE, np.int32)
+            for i, (_, slot) in enumerate(batch):
+                tb[i] = self.table[slot]
+            tables = jnp.asarray(tb)
         t0 = time.monotonic()
         want_lp = self._want_logprobs([r for r, _ in batch])
         out = prefill_batch_step(
             self.cfg, self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(true_lens), jnp.asarray(slots), self._next_rng(),
             jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
-            logprobs=want_lp)
+            logprobs=want_lp, tables=tables, seeds=jnp.asarray(seeds))
         lp_t = None
         if want_lp:
             self.cache, toks, lp_t = out
@@ -845,9 +1203,23 @@ class Engine:
             self._activate(req, slot, int(toks[i]), lp)
 
     def _start_chunk(self, req: Request, slot: int, pref):
-        """Begin chunked prefill of ``req`` into ``slot``; with a prefix-cache
-        hit (``pref = (src_slot, n)``), first copy the n resident rows from
-        the source slot and start the chunk walk at the suffix."""
+        """Begin chunked prefill of ``req`` into ``slot``.
+
+        Dense mode: with a prefix-cache hit (``pref = (src_slot, n)``), first
+        copy the n resident rows from the source slot and start the chunk
+        walk at the suffix. Paged mode (``pref = ("paged", ids, off)``): the
+        reused pages are already in the slot's table (hash-chain sharing, no
+        copy); the walk starts at the reuse offset, over ``ids`` — which is
+        prompt + generated for a preemption resume.
+        """
+        if self.paged:
+            _, ids, off, resumed = pref if pref is not None \
+                else ("paged", list(req.prompt_ids), 0, False)
+            self.lengths[slot] = off
+            self._chunk = {"req": req, "slot": slot, "off": off,
+                           "C": self._chunk_size, "ids": ids,
+                           "resumed": resumed}
+            return
         self._slot_tokens[slot] = ()   # rows about to be overwritten
         off = 0
         if pref is not None:
@@ -873,6 +1245,7 @@ class Engine:
         req, slot = st["req"], st["slot"]
         if req.cancelled:
             self._chunk = None
+            self._release_slot_pages(slot)
             self.sched.release(slot)
             req.finish_reason = "cancelled"
             self.metrics.mark_request("cancelled",
@@ -880,7 +1253,7 @@ class Engine:
             req.out_queue.put(None)
             return
         C = st["C"]
-        ids = req.prompt_ids
+        ids = st.get("ids") or req.prompt_ids
         off = st["off"]
         chunk = ids[off:off + C]
         tokens = np.zeros((1, C), np.int32)
@@ -894,13 +1267,18 @@ class Engine:
                 self._next_rng(), jnp.float32(req.temperature),
                 jnp.int32(req.top_k), jnp.float32(req.top_p),
                 logprobs=(req.logprobs is not None
-                          and off + len(chunk) >= len(ids)))
-            if req.logprobs is not None and off + len(chunk) >= len(ids):
+                          and not st.get("resumed")
+                          and off + len(chunk) >= len(ids)),
+                pages=jnp.asarray(self.table[slot]) if self.paged else None,
+                seed=jnp.uint32(req.eff_seed))
+            if req.logprobs is not None and not st.get("resumed") \
+                    and off + len(chunk) >= len(ids):
                 self.cache, token, lp_t = out
             else:
                 self.cache, token = out
         except Exception:
             self._chunk = None
+            self._release_slot_pages(slot)
             self.sched.release(slot)
             req.finish_reason = "error"
             self.metrics.mark_request("error", 0.0)
@@ -915,8 +1293,9 @@ class Engine:
         if st["off"] >= len(ids):
             self._chunk = None
             lp = _host_lp(lp_t, 0, req.logprobs) \
-                if req.logprobs is not None else None
-            self._activate(req, slot, int(token), lp)
+                if req.logprobs is not None and lp_t is not None else None
+            self._activate(req, slot, int(token), lp, ids=list(ids),
+                           resumed=st.get("resumed", False))
 
     def _propose_drafts(self, active: List[int]):
         """Prompt-lookup drafts per active slot: match the context's trailing
@@ -965,7 +1344,9 @@ class Engine:
             self.cfg, R, self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(self.lengths), self._next_rng(),
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
-            jnp.asarray(self.top_ps), impl=self.serving.attention_impl)
+            jnp.asarray(self.top_ps), impl=self.serving.attention_impl,
+            table=jnp.asarray(self.table) if self.paged else None,
+            seeds=jnp.asarray(self.seeds))
         out = np.asarray(out)
         accepted = np.asarray(accepted)
         dt = time.monotonic() - t0
@@ -1010,6 +1391,16 @@ class Engine:
         horizon = 1 if prefill_possible else max(1, self.serving.decode_horizon)
         if max_horizon is not None:
             horizon = min(horizon, max_horizon)
+        if self.paged:
+            # The device cannot allocate: every active slot's pages must
+            # cover its whole write horizon (incl. the spec path's R rows)
+            # BEFORE the dispatch. May preempt the newest requests when the
+            # pool runs dry — recompute the active set afterwards.
+            grow = max(horizon, (self.serving.spec_k + 1)
+                       if self.serving.spec_decode else 1)
+            if not self._ensure_pages(grow):
+                return
+            active = self._active_slots()
         # Speculative path: only when nothing is waiting (prefill priority
         # stands) and single-device (accept lengths are data-dependent per
         # slot; a dp mesh would desync). Falls back when no context matched.
@@ -1037,7 +1428,9 @@ class Engine:
             counts=self.counts if want_pen else None,
             presence=jnp.asarray(self.pres_pens) if want_pen else None,
             frequency=jnp.asarray(self.freq_pens) if want_pen else None,
-            penalties=want_pen)
+            penalties=want_pen,
+            table=jnp.asarray(self.table) if self.paged else None,
+            seeds=jnp.asarray(self.seeds))
         # un-penalized dispatches return a dummy counts array — keep ours
         self.counts = new_counts if want_pen else real_counts
         lp_t = None
@@ -1099,15 +1492,17 @@ class Engine:
                   else req.finish_reason or "success")
         self.metrics.mark_request(status, req.t_done - req.t_submit)
         self.slot_req[slot] = None
-        # Keep the freed slot's length: decode dispatches write a scratch K/V
-        # row for EVERY slot at its current length, so a zeroed length would
-        # let that garbage land on row 0 — corrupting the retained prompt
-        # rows the prefix cache reuses. At >= final length, scratch writes
-        # stay past the prompt (generation length >= 1 guarantees
-        # final length >= prompt length).
+        # Dense: keep the freed slot's length — decode dispatches write a
+        # scratch K/V row for EVERY slot at its current length, so a zeroed
+        # length would let that garbage land on row 0, corrupting the
+        # retained prompt rows the prefix cache reuses. (Paged: pages are
+        # RELEASED below — indexed ones stay prefix-matchable in the
+        # evictable LRU — and the zeroed table points idle writes at the
+        # scratch page, so the length resets to 0 there.)
         self.temps[slot] = 0.0
         self.pres_pens[slot] = 0.0
         self.freq_pens[slot] = 0.0
+        self._release_slot_pages(slot)
         self.sched.release(slot)
         self.metrics.active_requests.set(len(self._active_slots()))
         req.out_queue.put(None)  # sentinel: done
@@ -1161,10 +1556,13 @@ class Engine:
     def _fail_all(self, reason: str):
         if self._chunk is not None:  # fail the half-prefilled request too
             st, self._chunk = self._chunk, None
+            self._release_slot_pages(st["slot"])
             self.sched.release(st["slot"])
             st["req"].finish_reason = "error"
             self.metrics.mark_request("error", 0.0)
             st["req"].out_queue.put(None)
+        if self.paged:
+            self._resume_ctx.clear()   # queued resumes are failed below
         for slot, r in enumerate(self.slot_req):
             if r is not None:
                 r.finish_reason = "error"
@@ -1232,7 +1630,9 @@ class Engine:
                     jnp.asarray(self.last_token), jnp.asarray(self.lengths),
                     self._next_rng(), jnp.asarray(self.temps),
                     jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
-                    mesh=self.mesh, impl=self.serving.attention_impl)
+                    mesh=self.mesh, impl=self.serving.attention_impl,
+                    table=jnp.asarray(self.table) if self.paged else None,
+                    seeds=jnp.asarray(self.seeds))
             return
 
         # Distinct token values per warmup request — identical prompts would
@@ -1311,7 +1711,9 @@ class Engine:
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
             mesh=self.mesh, impl=self.serving.attention_impl,
             counts=cnts, presence=jnp.asarray(self.pres_pens),
-            frequency=jnp.asarray(self.freq_pens), penalties=True)
+            frequency=jnp.asarray(self.freq_pens), penalties=True,
+            table=jnp.asarray(self.table) if self.paged else None,
+            seeds=jnp.asarray(self.seeds))
         del cnts
         # Logprobs program variants ('logprobs' is a static arg on every step
         # fn — distinct programs): one isolated request compiles the
@@ -1339,4 +1741,6 @@ class Engine:
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
             self._next_rng(), jnp.asarray(self.temps),
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
-            mesh=self.mesh, impl=self.serving.attention_impl)
+            mesh=self.mesh, impl=self.serving.attention_impl,
+            table=jnp.asarray(self.table) if self.paged else None,
+            seeds=jnp.asarray(self.seeds))

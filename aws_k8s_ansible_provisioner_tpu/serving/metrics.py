@@ -175,6 +175,17 @@ class EngineMetrics:
         self.spec_accepted_tokens = r.register(Counter(
             "tpu_serve_spec_accepted_tokens_total",
             "Draft tokens accepted by the verify pass"))
+        # Paged-KV pool health (vLLM publishes the same trio as
+        # vllm:num_preemptions/gpu_cache_usage_perc): preemption spikes or a
+        # pinned-high page gauge mean the pool is undersized for the load.
+        self.preemptions = r.register(Counter(
+            "tpu_serve_preemptions_total",
+            "Requests preempted (pages reclaimed; resumed by recompute)"))
+        self.kv_pages_total = r.register(Gauge(
+            "tpu_serve_kv_pages_total", "Physical KV pages in the pool"))
+        self.kv_pages_in_use = r.register(Gauge(
+            "tpu_serve_kv_pages_in_use",
+            "KV pages currently referenced by live requests"))
 
     def mark_request(self, status: str, duration_s: float):
         self.request_total.inc(status=status)

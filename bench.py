@@ -59,7 +59,9 @@ L4_BASELINE_TOKS = 2500.0
 # One TPU attempt + one CPU fallback must BOTH fit the driver's ~900s cap,
 # with slack for parent startup and the kill/cleanup between them.
 TOTAL_BUDGET_S = float(os.environ.get("TPU_BENCH_TOTAL_BUDGET_S", 840))
-TPU_TIMEOUT_S = TOTAL_BUDGET_S - 220          # 620 at the default budget
+# Floor the TPU window so a small operator budget can't zero it out (the
+# attempt would then be killed instantly and mislabeled a backend failure).
+TPU_TIMEOUT_S = max(120.0, TOTAL_BUDGET_S - 220)   # 620 at default budget
 CPU_TIMEOUT_S = 180
 # v5e HBM bandwidth (bytes/s) for the roofline denominator; override for
 # other chip generations (v4: 1.2e12, v5p: 2.77e12, v6e: 1.6e12).

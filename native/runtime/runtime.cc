@@ -85,8 +85,17 @@ int32_t ts_cancel(ts_runtime* rt, int64_t req_id) {
   return 0;
 }
 
-int32_t ts_pop_admission(ts_runtime* rt, int64_t* req_id, int32_t* slot,
-                         int64_t* cancelled_id, int32_t* n_cancelled) {
+int32_t ts_submit_front(ts_runtime* rt, int64_t req_id, int32_t prompt_len,
+                        int32_t max_tokens) {
+  if (prompt_len < 0 || prompt_len + 1 > rt->max_len) return -1;
+  std::lock_guard<std::mutex> lock(rt->mu);
+  rt->queue.push_front(Pending{req_id, prompt_len, max_tokens});
+  return 0;
+}
+
+int32_t ts_pop_admission_paged(ts_runtime* rt, int64_t free_pages,
+                               int64_t* req_id, int32_t* slot,
+                               int64_t* cancelled_id, int32_t* n_cancelled) {
   std::lock_guard<std::mutex> lock(rt->mu);
   *n_cancelled = 0;
   int32_t free_slot =
@@ -105,6 +114,12 @@ int32_t ts_pop_admission(ts_runtime* rt, int64_t* req_id, int32_t* slot,
       return 0;
     }
     if (free_slot < 0) return 0;  // queue non-empty but no capacity
+    // Worst-case page need of the head prompt (+1 row for the first decoded
+    // token). Head-of-line blocks until pages free up — FCFS fairness.
+    const int64_t needed =
+        (static_cast<int64_t>(p.prompt_len) + 1 + rt->page_size - 1) /
+        rt->page_size;
+    if (needed > free_pages) return 0;
     rt->queue.pop_front();
     rt->free_slots.pop_front();
     rt->slot_req[free_slot] = p.req_id;
@@ -116,6 +131,13 @@ int32_t ts_pop_admission(ts_runtime* rt, int64_t* req_id, int32_t* slot,
     return 1;
   }
   return 0;
+}
+
+int32_t ts_pop_admission(ts_runtime* rt, int64_t* req_id, int32_t* slot,
+                         int64_t* cancelled_id, int32_t* n_cancelled) {
+  // Dense (slot-contiguous) admission = paged admission with infinite pages.
+  return ts_pop_admission_paged(rt, INT64_MAX, req_id, slot, cancelled_id,
+                                n_cancelled);
 }
 
 void ts_note_prefill(ts_runtime* rt, int32_t slot, int32_t length) {

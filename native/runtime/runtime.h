@@ -48,6 +48,13 @@ void ts_destroy(ts_runtime* rt);
 int32_t ts_submit(ts_runtime* rt, int64_t req_id, int32_t prompt_len,
                   int32_t max_tokens);
 
+/* Like ts_submit but enqueues at the FRONT of the FCFS queue. Used by the
+ * engine's paged-KV preemption (vLLM-style recompute): a preempted request
+ * re-enters first so it is resumed as soon as pages free up, preserving
+ * arrival-order fairness. */
+int32_t ts_submit_front(ts_runtime* rt, int64_t req_id, int32_t prompt_len,
+                        int32_t max_tokens);
+
 /* Cancel a request: removed from the queue if still pending (returns 1);
  * marked for reap if running in a slot (returns 2); unknown id returns 0. */
 int32_t ts_cancel(ts_runtime* rt, int64_t req_id);
@@ -58,6 +65,16 @@ int32_t ts_cancel(ts_runtime* rt, int64_t req_id);
  * skipped and written to `cancelled_id` (one per call, check *n_cancelled). */
 int32_t ts_pop_admission(ts_runtime* rt, int64_t* req_id, int32_t* slot,
                          int64_t* cancelled_id, int32_t* n_cancelled);
+
+/* Paged-KV admission: identical to ts_pop_admission, but the head request is
+ * only admitted when its worst-case prompt page need —
+ * ceil((prompt_len + 1) / page_size) — fits `free_pages` (the engine's
+ * allocator headroom at call time). Head-of-line blocking is deliberate
+ * (FCFS fairness, the vLLM scheduler's behavior): a big head request waits
+ * for pages rather than being overtaken. */
+int32_t ts_pop_admission_paged(ts_runtime* rt, int64_t free_pages,
+                               int64_t* req_id, int32_t* slot,
+                               int64_t* cancelled_id, int32_t* n_cancelled);
 
 /* Record prefill completion for `slot` at `length` tokens (prompt + first
  * generated token). */

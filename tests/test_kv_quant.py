@@ -202,7 +202,8 @@ def test_engine_int8_prefix_cache_copies_scales():
                             prefill_buckets=(64,), dtype="float32",
                             kv_dtype="int8", attention_impl="xla",
                             prefix_cache=True, prefix_cache_min_len=8,
-                            prefix_cache_payback_rows=8)
+                            prefix_cache_payback_rows=8,
+                            paged=False)   # dense copy_prefix under test
     eng = Engine(cfg, params, serving)
     r1 = eng.submit(Request(prompt_ids=list(seed), max_tokens=2,
                             ignore_eos=True))

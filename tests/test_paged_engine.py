@@ -1,0 +1,212 @@
+"""Paged-KV engine behavior: capacity scales with ACTUAL lengths, preemption
+resumes losslessly, prefix pages are shared (not copied), admission is gated
+by pages.
+
+This is the VERDICT r2 "done" criterion for the paged cache (missing #2 /
+next #3): a pool smaller than slots x window — which the dense layout could
+not even allocate — must admit and correctly serve every request whose true
+lengths fit, matching the on-demand block behavior of the vLLM engine the
+reference delegates to (SURVEY.md §2.2 row 1).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
+
+PS = 8
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def _engine(model, **kw):
+    cfg, params = model
+    base = dict(max_decode_slots=8, max_cache_len=64, page_size=PS,
+                prefill_buckets=(8, 16, 32), dtype="float32", paged=True)
+    base.update(kw)
+    return Engine(cfg, params, ServingConfig(**base))
+
+
+def _drain(eng):
+    while (any(s is not None for s in eng.slot_req) or eng.pending
+           or eng._chunk is not None):
+        eng.step()
+
+
+def _greedy_reference(model, prompt, n):
+    """Generate through a roomy DENSE engine — the correctness oracle."""
+    cfg, params = model
+    eng = Engine(cfg, params, ServingConfig(
+        max_decode_slots=2, max_cache_len=64, prefill_buckets=(8, 16, 32),
+        dtype="float32", paged=False))
+    r = eng.submit(Request(prompt_ids=list(prompt), max_tokens=n,
+                           ignore_eos=True))
+    _drain(eng)
+    return r.generated
+
+
+def test_paged_matches_dense_generation(model):
+    """Same greedy tokens through paged and dense engines (the whole paged
+    machinery — pool writers, block-table kernels, scratch page — must be
+    invisible to generation)."""
+    prompts = [[3, 5, 7, 11, 13], [2] * 17, [9, 8, 7, 6, 5, 4, 3, 2, 1]]
+    eng = _engine(model)
+    reqs = [eng.submit(Request(prompt_ids=list(p), max_tokens=6,
+                               ignore_eos=True)) for p in prompts]
+    _drain(eng)
+    for p, r in zip(prompts, reqs):
+        assert r.generated == _greedy_reference(model, p, 6), p
+
+
+def test_capacity_scales_with_actual_lengths(model):
+    """THE paged capacity property: 8 slots x 64-token window would need 64
+    pages dense; a 24-page pool (3 windows' worth) must still serve 8
+    CONCURRENT short requests — more in-flight sequences than the dense
+    layout could hold in the same HBM."""
+    eng = _engine(model, kv_pool_pages=24)
+    # 8 concurrent requests, each prompt 5 + gen 6 = 11 rows = 2 pages -> 16
+    # pages in flight <= 24; dense sizing would demand 64.
+    reqs = [eng.submit(Request(prompt_ids=[i + 2] * 5, max_tokens=6,
+                               ignore_eos=True)) for i in range(8)]
+    # step until all are ACTIVE at once (admission must not serialize them)
+    for _ in range(64):
+        eng.step()
+        if all(s is not None for s in eng.slot_req):
+            break
+    assert all(s is not None for s in eng.slot_req), \
+        "pool must admit all 8 concurrent short requests"
+    _drain(eng)
+    for i, r in enumerate(reqs):
+        assert len(r.generated) == 6
+        assert r.generated == _greedy_reference(model, [i + 2] * 5, 6)
+    st = eng.allocator.stats()
+    assert st["pages_live"] == 0       # everything released at finish
+
+
+def test_admission_gated_by_pages_not_slots(model):
+    """With 1 free page and 7 free slots, a 9-token prompt (2 pages) must
+    WAIT, and be admitted once a finishing request frees pages."""
+    eng = _engine(model, max_cache_len=32, kv_pool_pages=4)  # 4-page window
+    big = eng.submit(Request(prompt_ids=[4] * 17, max_tokens=2,
+                             ignore_eos=True))     # needs 3 pages
+    small = eng.submit(Request(prompt_ids=[5] * 9, max_tokens=2,
+                               ignore_eos=True))   # needs 2 > 1 left: waits
+    eng.step()                                     # admits+prefills big only
+    assert eng.slot_req.count(None) == eng.num_slots - 1
+    assert small.t_first_token == 0.0
+    _drain(eng)                                    # big finishes, small runs
+    assert len(big.generated) == 2 and len(small.generated) == 2
+
+
+def test_preemption_resumes_losslessly(model):
+    """Grow three streams until the pool runs dry: the newest request gets
+    preempted (pages reclaimed), resumed by recompute when pages free, and
+    its final token sequence is IDENTICAL to an unconstrained run."""
+    # window 64 rows = 8 pages/slot; pool of 12 pages forces pressure once
+    # 3 streams each pass ~4 pages (32 rows)
+    eng = _engine(model, kv_pool_pages=12)
+    gens = 40
+    reqs = [eng.submit(Request(prompt_ids=[i + 3] * 4, max_tokens=gens,
+                               ignore_eos=True)) for i in range(3)]
+    _drain(eng)
+    assert int(eng.metrics.preemptions.total()) > 0, \
+        "12 pages cannot hold 3 x ceil(44/8) pages — preemption must fire"
+    for i, r in enumerate(reqs):
+        assert len(r.generated) == gens
+        assert r.generated == _greedy_reference(model, [i + 3] * 4, gens), \
+            f"stream {i} diverged after preemption/resume"
+
+
+def test_prefix_pages_shared_no_copy(model):
+    """A follow-up prompt sharing full leading pages must hash-hit them:
+    prefix_tokens_reused grows, pages_live stays below two full prompts'
+    worth while both are active (sharing, not copying)."""
+    eng = _engine(model, kv_pool_pages=24)
+    seed = list(range(2, 2 + 2 * PS))              # exactly 2 full pages
+    r1 = eng.submit(Request(prompt_ids=list(seed), max_tokens=1,
+                            ignore_eos=True))
+    _drain(eng)
+    reused0 = eng.metrics.prefix_tokens_reused.total()
+    r2 = eng.submit(Request(prompt_ids=list(seed) + [50, 51, 52],
+                            max_tokens=1, ignore_eos=True))
+    _drain(eng)
+    assert eng.metrics.prefix_tokens_reused.total() - reused0 == 2 * PS
+    assert r2.generated == _greedy_reference(
+        model, seed + [50, 51, 52], 1)
+
+
+def test_preempted_resume_hits_its_own_pages(model):
+    """Preemption indexes the victim's full pages before releasing them, so
+    a resume with pool headroom re-prefills only the tail — observable as
+    prefix reuse. (Under real pressure those evictable pages may be
+    reclaimed by the survivors — then the resume recomputes, which the
+    lossless test above covers; here the preemption is forced white-box so
+    the pages provably survive.)"""
+    eng = _engine(model, kv_pool_pages=24)
+    r = eng.submit(Request(prompt_ids=[3] * 4, max_tokens=40,
+                           ignore_eos=True))
+    # run until the stream holds >= 2 full pages of context
+    for _ in range(200):
+        eng.step()
+        if len(r.generated) >= 2 * PS:
+            break
+    assert len(r.generated) >= 2 * PS
+    slot = next(s for s, rq in enumerate(eng.slot_req) if rq is r)
+    gen_at_preempt = len(r.generated)
+    eng._preempt(slot)
+    reused0 = eng.metrics.prefix_tokens_reused.total()
+    _drain(eng)
+    assert int(eng.metrics.preemptions.total()) == 1
+    assert eng.metrics.prefix_tokens_reused.total() - reused0 >= PS, \
+        "resume should hash-hit the preempted context's full pages"
+    assert len(r.generated) == 40
+    assert r.generated == _greedy_reference(model, [3] * 4, 40), \
+        f"diverged (preempted at {gen_at_preempt} generated)"
+
+
+def test_dense_mode_unaffected(model):
+    """paged=False keeps the slot-contiguous layout end to end."""
+    eng = _engine(model, paged=False)
+    assert not eng.paged and not hasattr(eng, "allocator")
+    r = eng.submit(Request(prompt_ids=[7] * 5, max_tokens=4, ignore_eos=True))
+    _drain(eng)
+    assert len(r.generated) == 4
+
+
+def test_preemption_preserves_penalty_counts(model):
+    """A penalized request preempted mid-stream must keep penalizing the
+    tokens it generated BEFORE the preemption — _activate restores the
+    counts row from req.generated on resume. Equality against an
+    unconstrained penalized run is the oracle."""
+    def run(preempt_after):
+        eng = _engine(model, kv_pool_pages=24)
+        r = eng.submit(Request(prompt_ids=[3] * 4, max_tokens=30,
+                               ignore_eos=True, presence_penalty=0.9,
+                               frequency_penalty=0.5))
+        for _ in range(400):
+            eng.step()
+            if preempt_after and len(r.generated) >= preempt_after:
+                slot = next((s for s, rq in enumerate(eng.slot_req)
+                             if rq is r), None)
+                if slot is not None:
+                    eng._preempt(slot)
+                    preempt_after = 0     # once
+            if r.finish_reason:
+                break
+        _drain(eng)
+        return r.generated
+
+    baseline = run(0)
+    preempted = run(10)
+    assert len(baseline) == 30
+    assert preempted == baseline, \
+        "penalty state diverged across preemption/resume"

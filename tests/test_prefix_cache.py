@@ -1,4 +1,6 @@
-"""Automatic prefix caching: K/V reuse across requests sharing a prompt prefix.
+"""Automatic prefix caching (DENSE slot-contiguous mode: these tests pin
+the copy-based token-level cache used under a mesh; the paged page-sharing
+equivalent is covered by tests/test_paged_engine.py): K/V reuse across requests sharing a prompt prefix.
 
 The vLLM feature of the same name (inside the reference's serving pods),
 rebuilt for the slot-contiguous cache: the prefix is a contiguous row range,
@@ -29,7 +31,7 @@ def setup():
     serving = ServingConfig(max_decode_slots=4, max_cache_len=128,
                             prefill_buckets=(16, 64), dtype="float32",
                             prefix_cache_min_len=8,
-                            prefix_cache_payback_rows=1)
+                            prefix_cache_payback_rows=1, paged=False)
     return cfg, params, serving
 
 

@@ -144,3 +144,40 @@ def test_native_sanitizers_clean():
     except subprocess.CalledProcessError as e:
         pytest.fail(f"sanitizer run failed:\n{e.stdout}\n{e.stderr}")
     assert out.stdout.count("-> OK") >= 1
+
+
+def test_submit_front_resumes_first(make):
+    # Paged-KV preemption resume: a front-submitted request overtakes the
+    # FCFS queue (it already held its arrival-order turn once).
+    s = make(1, 64, 16)
+    assert s.submit(1, 10, 8)
+    assert s.pop_admission() == ("admit", 1, 0)
+    assert s.submit(2, 10, 8)
+    assert s.submit_front(9, 20, 4)            # preempted request re-enters
+    assert s.pop_admission() is None           # no free slot yet
+    assert s.release(0) == 1
+    assert s.pop_admission() == ("admit", 9, 0)
+
+
+def test_paged_admission_gates_by_free_pages(make):
+    # page_size 16: a 20-token prompt needs ceil(21/16) = 2 pages.
+    s = make(4, 64, 16)
+    assert s.submit(1, 20, 8)
+    assert s.pop_admission(free_pages=1) is None     # head blocks (FCFS)
+    assert s.pop_admission(free_pages=2) == ("admit", 1, 0)
+    # head-of-line blocking: a small request behind a big one must wait
+    assert s.submit(2, 60, 4)                        # needs 4 pages
+    assert s.submit(3, 1, 4)                         # needs 1 page
+    assert s.pop_admission(free_pages=3) is None
+    assert s.pop_admission(free_pages=4) == ("admit", 2, 1)
+    assert s.pop_admission(free_pages=1) == ("admit", 3, 2)
+
+
+def test_paged_admission_still_surfaces_cancellations(make):
+    s = make(2, 64, 16)
+    assert s.submit(1, 30, 8)
+    assert s.cancel(1) == 1
+    assert s.submit(2, 10, 8)
+    assert s.pop_admission(free_pages=0) == ("cancelled", 1)
+    assert s.pop_admission(free_pages=0) is None     # 2 blocked on pages
+    assert s.pop_admission(free_pages=1) == ("admit", 2, 0)

@@ -296,3 +296,78 @@ def test_engine_stall_detection():
     assert eng.stalled_for_s == 0.0                      # healthy in-step
     eng.last_step_start = _time.monotonic() - eng.STALL_AFTER_S - 5
     assert eng.stalled_for_s > 0.0                       # wedged
+
+
+# -- seed / echo / best_of (VERDICT r2 missing #4 / next #7) -----------------
+
+
+def test_seed_reproducible_sampling(server):
+    payload = {"model": MODEL_NAME, "prompt": "seed me", "max_tokens": 8,
+               "temperature": 0.9, "seed": 1234}
+    _, a = _post(server + "/v1/completions", payload)
+    _, b = _post(server + "/v1/completions", payload)
+    assert a["choices"][0]["text"] == b["choices"][0]["text"], \
+        "same seed must reproduce the sampled stream"
+    _, c = _post(server + "/v1/completions", {**payload, "seed": 99})
+    # different seed, overwhelmingly likely a different stream
+    assert c["choices"][0]["text"] != a["choices"][0]["text"]
+
+
+def test_seed_invalid_rejected(server):
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server + "/v1/completions", {
+            "model": MODEL_NAME, "prompt": "x", "seed": "abc"})
+    assert e.value.code == 400
+
+
+def test_echo_prepends_prompt(server):
+    prompt = "Echo chamber"
+    _, body = _post(server + "/v1/completions", {
+        "model": MODEL_NAME, "prompt": prompt, "max_tokens": 4})
+    plain = body["choices"][0]["text"]
+    _, body2 = _post(server + "/v1/completions", {
+        "model": MODEL_NAME, "prompt": prompt, "max_tokens": 4,
+        "echo": True})
+    assert body2["choices"][0]["text"] == prompt + plain
+
+
+def test_echo_with_logprobs_offsets_past_prompt(server):
+    prompt = "offsets"
+    _, body = _post(server + "/v1/completions", {
+        "model": MODEL_NAME, "prompt": prompt, "max_tokens": 4,
+        "echo": True, "logprobs": 1})
+    lp = body["choices"][0]["logprobs"]
+    # completion-token offsets start after the echoed prompt text
+    assert lp["text_offset"][0] == len(prompt)
+    assert len(lp["tokens"]) == 4
+
+
+def test_echo_rejected_on_chat(server):
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server + "/v1/chat/completions", {
+            "model": MODEL_NAME, "echo": True,
+            "messages": [{"role": "user", "content": "hi"}]})
+    assert e.value.code == 400
+
+
+def test_best_of_returns_n_ranked_choices(server):
+    _, body = _post(server + "/v1/completions", {
+        "model": MODEL_NAME, "prompt": "rank us", "max_tokens": 6,
+        "temperature": 1.0, "n": 2, "best_of": 4, "seed": 7})
+    choices = body["choices"]
+    assert len(choices) == 2
+    assert [c["index"] for c in choices] == [0, 1]
+    # internal ranking logprobs must NOT leak into the response
+    assert all(c["logprobs"] is None for c in choices)
+    # usage counts ALL best_of candidates' tokens (they were generated)
+    assert body["usage"]["completion_tokens"] >= 6 * 4 - 4
+
+
+def test_best_of_smaller_than_n_rejected(server):
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server + "/v1/completions", {
+            "model": MODEL_NAME, "prompt": "x", "n": 3, "best_of": 2})
+    assert e.value.code == 400
