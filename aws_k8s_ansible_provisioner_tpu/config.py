@@ -756,6 +756,21 @@ def ansible_vars(cfg: FrameworkConfig | None = None) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_manifest(path: str, **overrides) -> str:
+    """Render a deploy/ Jinja manifest with the config vars — the ONE render
+    pipeline shared by the CLI (--render-manifest, used by
+    deploy/rehearse-kind.sh), the playbooks' var contract, and the tests
+    (StrictUndefined: a typo'd var fails the render, not the cluster)."""
+    import jinja2
+    import yaml as _yaml
+
+    vars_ = _yaml.safe_load(ansible_vars())
+    vars_.update(overrides)
+    env = jinja2.Environment(undefined=jinja2.StrictUndefined)
+    with open(path) as f:
+        return env.from_string(f.read()).render(**vars_)
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -770,19 +785,14 @@ if __name__ == "__main__":
                    help="override a var for --render-manifest")
     args = p.parse_args()
     if args.render_manifest:
-        import jinja2
-        import yaml as _yaml
-
-        vars_ = _yaml.safe_load(ansible_vars())
+        overrides = {}
         for kv in args.set:
             k, _, v = kv.partition("=")
             try:
-                vars_[k] = json.loads(v)
+                overrides[k] = json.loads(v)
             except (ValueError, TypeError):
-                vars_[k] = v
-        env = jinja2.Environment(undefined=jinja2.StrictUndefined)
-        with open(args.render_manifest) as f:
-            print(env.from_string(f.read()).render(**vars_))
+                overrides[k] = v
+        print(render_manifest(args.render_manifest, **overrides))
     elif args.ansible_vars:
         print(ansible_vars(), end="")
     else:

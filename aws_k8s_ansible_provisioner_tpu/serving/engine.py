@@ -765,6 +765,10 @@ class Engine:
         row is still pending the next dispatch, so indexing past
         len(ids) - 1 would publish a page with one garbage row to every
         future prefix hit (review r3)."""
+        if not self.serving.prefix_cache:
+            # no lookup side -> indexing would be pure overhead, and
+            # unindexed pages go straight back to the free list at release
+            return
         ps = self.serving.page_size
         pages = self._slot_pages[slot]
         n_valid = len(ids) if n_valid is None else n_valid

@@ -33,16 +33,12 @@ REPO = Path(__file__).resolve().parent.parent
 
 
 def _render(**overrides):
-    import jinja2
+    # the SAME pipeline rehearse-kind.sh uses (config.render_manifest): the
+    # test validates the renderer the script will actually run
+    from aws_k8s_ansible_provisioner_tpu.config import render_manifest
 
-    from aws_k8s_ansible_provisioner_tpu.config import ansible_vars
-
-    vars_ = yaml.safe_load(ansible_vars())
-    vars_.update(overrides)
-    env = jinja2.Environment(undefined=jinja2.StrictUndefined)
-    text = env.from_string(
-        (REPO / "deploy" / "manifests" / "serving.yaml.j2").read_text()
-    ).render(**vars_)
+    text = render_manifest(
+        str(REPO / "deploy" / "manifests" / "serving.yaml.j2"), **overrides)
     return [d for d in yaml.safe_load_all(text) if d]
 
 
