@@ -720,6 +720,24 @@ def main(argv=None):
 
         jax.config.update("jax_platforms", args.platform)
 
+    # Persistent XLA compilation cache: warmup compiles ~13 programs (20-40s
+    # each on TPU); a CONTAINER restart (the liveness probe's stall-recovery
+    # kick) must not pay that again. The serving manifest backs the path
+    # with an emptyDir and pins JAX_COMPILATION_CACHE_DIR to it — pod-level
+    # restarts (rollout, node drain) start cold; back the path with a PVC if
+    # rollout survival matters. Env JAX_COMPILATION_CACHE_DIR overrides.
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), "tpu-serve-xla-cache"))
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        log.warning("persistent compile cache unavailable", exc_info=True)
+
     from aws_k8s_ansible_provisioner_tpu.config import MeshConfig
 
     serving = ServingConfig(

@@ -324,6 +324,12 @@ def measure() -> None:
         max_prefill_batch=int(env("TPU_BENCH_PREFILL_BATCH",
                                   32 if on_tpu else 4)),
         kv_dtype=kv_dtype,
+        # The headline number reproduces the r2-measured DENSE config until
+        # the paged kernels get chip time (they are CPU-interpret-validated;
+        # Mosaic lowering on real TPU is not, and the bench must never
+        # gamble the round's one measurement on it). TPU_BENCH_PAGED=1 A/Bs
+        # the paged path on hardware.
+        paged=bool(int(env("TPU_BENCH_PAGED", "0"))),
     )
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.bfloat16)
     engine = Engine(cfg, params, serving)
@@ -384,6 +390,7 @@ def measure() -> None:
             "platform": platform,
             "attention_impl": impl,
             "kv_dtype": serving.kv_dtype,
+            "paged": serving.paged,
             "ttft_p50_ms": round(ttft_p50_ms, 2),
             "batch": n_slots,
             "decode_horizon": horizon,

@@ -1,0 +1,89 @@
+"""Bounded tuning sweep over bench.py configs on the real chip.
+
+Runs each config as a fresh ``bench.py --measure`` child (same process
+isolation as the bench parent: a failed backend init never poisons the next
+attempt) with a per-config time cap, appending one JSON line per result to
+``bench_sweep_results.jsonl``. The persistent XLA compile cache makes
+config revisits cheap.
+
+Usage:
+    python bench_sweep.py                  # default grid (paged A/B + horizon)
+    python bench_sweep.py --cap 300        # per-config seconds
+    TPU_BENCH_BATCH=64 python bench_sweep.py --grid paged=0,1
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+
+DEFAULT_GRID = {
+    # the questions worth chip time this round, cheapest first:
+    # 1) do the paged block-table kernels match dense throughput?
+    # 2) does a bigger horizon still pay at int8/batch-128?
+    "TPU_BENCH_PAGED": ["0", "1"],
+    "TPU_BENCH_HORIZON": ["96", "128"],
+}
+
+
+def parse_grid(spec: str) -> dict:
+    grid = {}
+    for part in spec.split(";"):
+        k, _, vals = part.partition("=")
+        grid["TPU_BENCH_" + k.upper() if not k.startswith("TPU_") else k] = \
+            vals.split(",")
+    return grid
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cap", type=float, default=420.0,
+                    help="seconds per config (child budget = cap - 15)")
+    ap.add_argument("--grid", default="",
+                    help="e.g. 'paged=0,1;horizon=64,96,128'")
+    ap.add_argument("--out", default="bench_sweep_results.jsonl")
+    args = ap.parse_args()
+    grid = parse_grid(args.grid) if args.grid else DEFAULT_GRID
+    keys = sorted(grid)
+    combos = list(itertools.product(*(grid[k] for k in keys)))
+    here = os.path.dirname(os.path.abspath(__file__))
+    results = []
+    for combo in combos:
+        env = dict(os.environ)
+        env.update(dict(zip(keys, combo)))
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(here, ".jax_compile_cache"))
+        env["TPU_BENCH_CHILD_BUDGET_S"] = str(max(60.0, args.cap - 15.0))
+        label = {k.replace("TPU_BENCH_", "").lower(): v
+                 for k, v in zip(keys, combo)}
+        sys.stderr.write(f"sweep: {label} (cap {args.cap}s)\n")
+        t0 = time.monotonic()
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.join(here, "bench.py"), "--measure"],
+                capture_output=True, text=True, timeout=args.cap, env=env)
+            line = next((ln for ln in reversed(p.stdout.splitlines())
+                         if ln.strip().startswith("{")), None)
+            rec = json.loads(line) if line else {
+                "error": (p.stderr or "")[-300:]}
+        except subprocess.TimeoutExpired:
+            rec = {"error": f"timed out after {args.cap}s"}
+        rec["sweep"] = label
+        rec["sweep_wall_s"] = round(time.monotonic() - t0, 1)
+        results.append(rec)
+        with open(os.path.join(here, args.out), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        sys.stderr.write(f"sweep: -> {rec.get('value', rec.get('error'))}\n")
+    best = max((r for r in results if "value" in r),
+               key=lambda r: r["value"], default=None)
+    print(json.dumps({"configs": len(results), "best": best}))
+    return 0 if best else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,13 @@ import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# NOTE: do NOT enable jax's persistent compilation cache here — serializing
+# INTERPRET-mode Pallas executables (the CPU test path for every kernel)
+# segfaults in put_executable_and_time (observed: full-suite crash in
+# test_sliding_window's pallas-interpret engine test). The bench/server
+# caches are safe: on TPU the kernels lower to serializable Mosaic custom
+# calls, and the CPU fallback resolves to the XLA attention path.
+
 
 @pytest.fixture(scope="session")
 def cpu_devices():
