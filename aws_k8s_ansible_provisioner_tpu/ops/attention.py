@@ -405,13 +405,45 @@ def make_prefill_attend(slot: jnp.ndarray, seq_len: jnp.ndarray,
 
 
 def make_decode_attend_carry_paged(lengths: jnp.ndarray, table: jnp.ndarray,
-                                   impl: str = "auto", window: int = 0):
+                                   impl: str = "auto", mesh=None,
+                                   window: int = 0):
     """Carry-path decode attend over the PAGED pool: cache_l is
     ``(pool, layer_idx)``; ``table`` [B, max_pages] int32 maps each slot's
     logical pages to physical pool pages. The engine guarantees every row in
     [0, lengths[b] + 1) — and the row being written — lives in an allocated
-    page (Engine._ensure_pages)."""
+    page (Engine._ensure_pages).
+
+    With a ``mesh``, the pool shards its KV-HEAD axis over ``tp``
+    (parallel/sharding.pool_pspecs) and shard_map runs the paged kernels on
+    each chip's head slice of every page — the block table, lengths, and
+    allocator are head-independent and shared verbatim. The tp flagship
+    config (Qwen3-8B over v5e-8 ICI) thus keeps on-demand paging; dp/sp
+    meshes serve the dense layout (Engine gates)."""
     resolved = resolve_impl(impl)
+
+    def _write_attend_paged(q, pool, knew, vnew, lens, tab, layer):
+        from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
+
+        interpret = jax.default_backend() != "tpu"
+        ck, cv = pool["k"], pool["v"]
+        if "ks" in pool:
+            ck, ks = pallas_attention.cache_write_row_quant_paged(
+                ck, pool["ks"], knew, lens, tab, layer, interpret=interpret)
+            cv, vs = pallas_attention.cache_write_row_quant_paged(
+                cv, pool["vs"], vnew, lens, tab, layer, interpret=interpret)
+            pool = {"k": ck, "v": cv, "ks": ks, "vs": vs}
+            scale_kw = dict(pool_ks=ks, pool_vs=vs)
+        else:
+            ck = pallas_attention.cache_write_row_paged(
+                ck, knew, lens, tab, layer, interpret=interpret)
+            cv = pallas_attention.cache_write_row_paged(
+                cv, vnew, lens, tab, layer, interpret=interpret)
+            pool = {"k": ck, "v": cv}
+            scale_kw = {}
+        ctx = pallas_attention.decode_attend_pallas_paged(
+            q, ck, cv, lens + 1, layer, tab, interpret=interpret,
+            window=window, **scale_kw)
+        return ctx, pool
 
     def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, tuple]:
         from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
@@ -419,30 +451,31 @@ def make_decode_attend_carry_paged(lengths: jnp.ndarray, table: jnp.ndarray,
         pool, layer = cache_l
         ps = pool["k"].shape[3]
         if resolved == "pallas":
-            from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
-
-            interpret = jax.default_backend() != "tpu"
             knew, vnew = k[:, 0], v[:, 0]
-            ck, cv = pool["k"], pool["v"]
-            if "ks" in pool:
-                ck, ks = pallas_attention.cache_write_row_quant_paged(
-                    ck, pool["ks"], knew, lengths, table, layer,
-                    interpret=interpret)
-                cv, vs = pallas_attention.cache_write_row_quant_paged(
-                    cv, pool["vs"], vnew, lengths, table, layer,
-                    interpret=interpret)
-                pool = {"k": ck, "v": cv, "ks": ks, "vs": vs}
-                scale_kw = dict(pool_ks=ks, pool_vs=vs)
+            if mesh is not None:
+                from jax.experimental.shard_map import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                from aws_k8s_ansible_provisioner_tpu.parallel.sharding import (
+                    pool_pspecs)
+
+                pool_spec = pool_pspecs(quant="ks" in pool)
+                fn = shard_map(
+                    _write_attend_paged, mesh=mesh,
+                    in_specs=(P(None, None, "tp", None),  # q [B,1,Hq,D]
+                              pool_spec,                  # pool leaf dict
+                              P(None, "tp", None),        # knew [B,Hkv,D]
+                              P(None, "tp", None),        # vnew
+                              P(None),                    # lengths [B]
+                              P(None, None),              # table (replicated)
+                              P()),                       # layer scalar
+                    out_specs=(P(None, None, "tp", None), pool_spec),
+                    check_rep=False,
+                )
+                ctx, pool = fn(q, pool, knew, vnew, lengths, table, layer)
             else:
-                ck = pallas_attention.cache_write_row_paged(
-                    ck, knew, lengths, table, layer, interpret=interpret)
-                cv = pallas_attention.cache_write_row_paged(
-                    cv, vnew, lengths, table, layer, interpret=interpret)
-                pool = {"k": ck, "v": cv}
-                scale_kw = {}
-            ctx = pallas_attention.decode_attend_pallas_paged(
-                q, ck, cv, lengths + 1, layer, table, interpret=interpret,
-                window=window, **scale_kw)
+                ctx, pool = _write_attend_paged(q, pool, knew, vnew,
+                                                lengths, table, layer)
             return ctx, (pool, layer)
         pool = pkv.write_token_layer_paged(pool, layer, lengths, table, k, v,
                                            ps)

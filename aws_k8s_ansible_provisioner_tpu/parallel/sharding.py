@@ -135,6 +135,23 @@ def cache_pspecs(quant: bool = False) -> dict:
     return specs
 
 
+def pool_pspecs(quant: bool = False) -> dict:
+    """Paged KV pool [L, pages, Hkv, page, D]: kv heads over tp — the ONLY
+    sharded axis. Page identity is head-independent, so the block tables,
+    lengths, and the host allocator are replicated/shared verbatim across tp
+    shards; a tp group serves one paged engine with each chip holding its
+    heads' slice of every page (serving/paged_kv.py; the dp/sp axes keep the
+    dense layout — per-dp-group pools are future work)."""
+    specs = {
+        "k": P(None, None, "tp", None, None),
+        "v": P(None, None, "tp", None, None),
+    }
+    if quant:
+        specs["ks"] = P(None, None, "tp", None)
+        specs["vs"] = P(None, None, "tp", None)
+    return specs
+
+
 def tokens_pspec(seq_sharded: bool = False) -> P:
     """[B, T] activations: batch over dp, optionally sequence over sp."""
     return P("dp", "sp" if seq_sharded else None)

@@ -108,6 +108,12 @@ class Request:
     seed: Optional[int] = None
     # resolved at submit(): seed, or the engine's derived default
     eff_seed: int = 0
+    # vLLM ``stop_token_ids``: extra per-request stop tokens (the model's
+    # eos set still applies unless ignore_eos).
+    stop_token_ids: tuple = ()
+    # vLLM ``min_tokens``: suppress ALL stop tokens (eos + stop_token_ids)
+    # until this many tokens have been generated (budget still caps).
+    min_tokens: int = 0
     id: int = field(default_factory=lambda: next(_REQUEST_IDS))
     # Filled in by the engine:
     generated: List[int] = field(default_factory=list)
@@ -139,6 +145,26 @@ class Request:
 # Static top-k width for OpenAI ``logprobs`` responses (vLLM caps similarly);
 # per-request k <= this is sliced on the host.
 LOGPROB_K = 8
+
+# Static width of the per-slot banned-token list (min_tokens stop
+# suppression): eos set + stop_token_ids must fit. Rows pad with an
+# out-of-vocab id, which the masking scatter DROPS.
+BAN_K = 8
+
+
+def _mask_banned(logits: jnp.ndarray, ban_ids, ban_until, lens) -> jnp.ndarray:
+    """vLLM ``min_tokens`` semantics: while a slot's context length is below
+    ``ban_until`` (prompt_len + min_tokens), its stop tokens are masked to
+    -inf BEFORE sampling — a suppressed eos is never produced, never
+    streamed, never conditions later tokens. Always-on (no program variant):
+    slots with nothing to ban carry out-of-vocab ids, and the scatter drops
+    them. logits: [B, V]; ban_ids: [B, BAN_K] int32; ban_until/lens: [B]."""
+    if ban_ids is None:
+        return logits
+    B = logits.shape[0]
+    active = (lens < ban_until)[:, None]
+    ids = jnp.where(active, ban_ids, jnp.int32(2**31 - 1))
+    return logits.at[jnp.arange(B)[:, None], ids].set(-jnp.inf, mode="drop")
 
 
 def _logprob_topk(logits: jnp.ndarray, chosen: jnp.ndarray):
@@ -190,7 +216,7 @@ def _restore_count_row(counts, slot, row):
          donate_argnums=(2,))
 def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
                  temperature, top_k, top_p, logprobs: bool = False,
-                 pages=None, seed=None):
+                 pages=None, seed=None, ban_ids=None, ban_until=None):
     """Prefill one prompt into one slot; returns (cache, first sampled token).
 
     tokens: [1, T] right-padded to a bucket; true_len: scalar valid length;
@@ -207,16 +233,19 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
         attend = make_prefill_attend(slot, true_len,
                                      window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
-    last = jnp.take(logits[0], true_len - 1, axis=0)       # [V]
+    last = jnp.take(logits[0], true_len - 1, axis=0)[None]   # [1, V]
+    if ban_ids is not None:
+        last = _mask_banned(last, ban_ids[None], ban_until[None],
+                            true_len[None])
     # Per-request seeded draw: key = (seed, position), so the stream is
     # reproducible across restarts/preemption (OpenAI `seed`). ``rng`` is
     # the legacy fallback when no seed rides the dispatch.
     keys = per_slot_keys(seed[None], true_len[None]) if seed is not None \
         else rng
-    token = sample(last[None, :], keys, temperature[None], top_k[None],
+    token = sample(last, keys, temperature[None], top_k[None],
                    top_p[None])[0]
     if logprobs:
-        return cache, token, _logprob_topk(last[None, :], token[None])
+        return cache, token, _logprob_topk(last, token[None])
     return cache, token
 
 
@@ -224,7 +253,8 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
          donate_argnums=(2,))
 def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
                        slots, rng, temperature, top_k, top_p,
-                       logprobs: bool = False, tables=None, seeds=None):
+                       logprobs: bool = False, tables=None, seeds=None,
+                       ban_ids=None, ban_until=None):
     """Prefill N prompts into N slots in ONE dispatch.
 
     tokens: [N, T] right-padded to a (row, length) bucket; true_lens/slots/
@@ -245,6 +275,8 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
                                            window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = logits[jnp.arange(N), true_lens - 1]            # [N, V]
+    if ban_ids is not None:
+        last = _mask_banned(last, ban_ids, ban_until, true_lens)
     keys = per_slot_keys(seeds, true_lens) if seeds is not None else rng
     toks = sample(last, keys, temperature, top_k, top_p)
     if logprobs:
@@ -256,7 +288,8 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
          donate_argnums=(2,))
 def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
                        chunk_len, rng, temperature, top_k, top_p,
-                       logprobs: bool = False, pages=None, seed=None):
+                       logprobs: bool = False, pages=None, seed=None,
+                       ban_ids=None, ban_until=None):
     """Prefill ONE chunk of a long prompt; decode interleaves between chunks.
 
     tokens: [1, C] (the chunk, right-padded on the final chunk); start: row
@@ -276,17 +309,20 @@ def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
         attend = make_chunk_prefill_attend(slot, start,
                                            window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
-    last = jnp.take(logits[0], chunk_len - 1, axis=0)      # [V]
+    last = jnp.take(logits[0], chunk_len - 1, axis=0)[None]  # [1, V]
+    if ban_ids is not None:
+        last = _mask_banned(last, ban_ids[None], ban_until[None],
+                            (start + chunk_len)[None])
     # ctr = start + chunk_len = the full context length at the FINAL chunk
     # (the only one whose sample survives) — matching what decode/prefill
     # would use for the same position, so seeded streams are chunking-layout
     # independent.
     keys = per_slot_keys(seed[None], (start + chunk_len)[None]) \
         if seed is not None else rng
-    token = sample(last[None, :], keys, temperature[None], top_k[None],
+    token = sample(last, keys, temperature[None], top_k[None],
                    top_p[None])[0]
     if logprobs:
-        return cache, token, _logprob_topk(last[None, :], token[None])
+        return cache, token, _logprob_topk(last, token[None])
     return cache, token
 
 
@@ -298,7 +334,8 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
                  lengths, rng, temperature, top_k, top_p, mesh=None,
                  impl: str = "auto", logprobs: bool = False,
                  counts=None, presence=None, frequency=None,
-                 penalties: bool = False, table=None, seeds=None):
+                 penalties: bool = False, table=None, seeds=None,
+                 ban_ids=None, ban_until=None):
     """``n_steps`` fused decode steps for every slot, one device dispatch.
 
     tokens/lengths/sampling params: [B]. Returns (cache, out [n_steps, B]).
@@ -324,7 +361,7 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
         # is the paged pool and the kernels address pages through it.
         if table is not None:
             attend = make_decode_attend_carry_paged(
-                lens, table, impl=impl, window=cfg.sliding_window)
+                lens, table, impl=impl, mesh=mesh, window=cfg.sliding_window)
         else:
             attend = make_decode_attend_carry(lens, impl=impl, mesh=mesh,
                                               window=cfg.sliding_window)
@@ -337,6 +374,9 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
             # repeat is penalized immediately, not at the next dispatch)
             step_logits = apply_penalties(step_logits, cnts, presence,
                                           frequency)
+        # min_tokens stop suppression evaluates PER SUBSTEP (lens rides the
+        # carry), so a ban can expire mid-horizon exactly when vLLM's would
+        step_logits = _mask_banned(step_logits, ban_ids, ban_until, lens)
         # ctr = lens + 1 = the context length this draw extends TO: distinct
         # from the prefill draw's ctr (= prompt length) and equal to what a
         # preemption-resume prefill of the same position would use — the
@@ -496,10 +536,17 @@ class Engine:
                     f"cache window {self.max_len} must split into 8-row-"
                     f"aligned sequence shards; not divisible by sp={sp} * 8")
             self.params = params = shard_params(params, self.mesh, cfg)
-        # True paged KV (single-device): shared page pool + block tables; the
-        # mesh path keeps the dense slot-contiguous layout (per-dp-group
-        # pools are future work — see ServingConfig.paged).
-        self.paged = bool(serving.paged) and self.mesh is None
+        # True paged KV: shared page pool + block tables. Composes with tp
+        # (and ep) meshes — the pool shards only its KV-HEAD axis, so page
+        # identity, tables, and the host allocator are shard-invariant
+        # (parallel/sharding.pool_pspecs). dp shards SLOTS (each group would
+        # need its own pool partition — future work) and sp shards the
+        # sequence axis (incompatible with the pool layout), so those keep
+        # the dense slot-contiguous cache.
+        self.paged = bool(serving.paged) and (
+            self.mesh is None
+            or (self.mesh.shape.get("dp", 1) == 1
+                and self.mesh.shape.get("sp", 1) == 1))
         if self.paged:
             from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
 
@@ -524,8 +571,24 @@ class Engine:
             # +1: physical page 0 is the SCRATCH page — every idle slot's
             # table points at it, so the decode programs' per-slot garbage
             # row writes can never land in a page another slot owns.
-            self.cache = pkv.init_pool(cfg, pool_pages + 1, ps, dtype,
-                                       quant=self.kv_quant)
+            if self.mesh is not None:
+                # born sharded (heads over tp): no device ever holds the
+                # full pool — same rationale as the dense mesh cache below
+                from jax.sharding import NamedSharding
+
+                from aws_k8s_ansible_provisioner_tpu.parallel.sharding import (
+                    pool_pspecs)
+
+                out_sh = {name: NamedSharding(self.mesh, spec)
+                          for name, spec in
+                          pool_pspecs(self.kv_quant).items()}
+                self.cache = jax.jit(
+                    lambda: pkv.init_pool(cfg, pool_pages + 1, ps, dtype,
+                                          quant=self.kv_quant),
+                    out_shardings=out_sh)()
+            else:
+                self.cache = pkv.init_pool(cfg, pool_pages + 1, ps, dtype,
+                                           quant=self.kv_quant)
             self.allocator = pkv.PagePool(pool_pages + 1, ps, first_page=1)
             self.table = np.zeros((self.num_slots, self.pages_per_slot),
                                   np.int32)
@@ -573,6 +636,11 @@ class Engine:
         self.top_ks = np.zeros(self.num_slots, np.int32)
         self.top_ps = np.ones(self.num_slots, np.float32)
         self.seeds = np.zeros(self.num_slots, np.uint32)
+        # min_tokens stop suppression: per-slot banned-token lists (padded
+        # with an out-of-vocab id — the masking scatter drops them) active
+        # while the slot's context length < ban_until (prompt + min_tokens)
+        self.ban_ids = np.full((self.num_slots, BAN_K), 2**31 - 1, np.int32)
+        self.ban_until = np.zeros(self.num_slots, np.int32)
         self.pres_pens = np.zeros(self.num_slots, np.float32)
         self.freq_pens = np.zeros(self.num_slots, np.float32)
         # [num_slots, V] generated-token counts, allocated lazily on the
@@ -852,6 +920,7 @@ class Engine:
         self.temps[slot] = 0.0
         self.pres_pens[slot] = 0.0
         self.freq_pens[slot] = 0.0
+        self.ban_until[slot] = 0
         self._release_slot_pages(slot)
         self.sched.release(slot)
         remaining = max(1, req.max_tokens - len(req.generated))
@@ -871,6 +940,12 @@ class Engine:
         if len(req.prompt_ids) > self.prompt_limit:
             raise ContextLengthExceeded(len(req.prompt_ids), self.prompt_limit,
                                         self.max_len)
+        if req.min_tokens > 0:
+            n_ban = len(self._ban_set(req))
+            if n_ban > BAN_K:
+                raise ValueError(
+                    f"min_tokens suppression supports at most {BAN_K} stop "
+                    f"tokens (eos set + stop_token_ids = {n_ban})")
         budget = self.max_len - len(req.prompt_ids) - 1
         if req.max_tokens > budget:
             req.max_tokens = max(1, budget)
@@ -894,6 +969,12 @@ class Engine:
 
     def _want_logprobs(self, reqs) -> bool:
         return any(r is not None and r.logprobs is not None for r in reqs)
+
+    def _ban_set(self, req: Request) -> set:
+        """Tokens suppressed for this request while min_tokens is unmet —
+        exactly the set _emit would stop on."""
+        base = set() if req.ignore_eos else set(self._eos_set)
+        return base | set(req.stop_token_ids)
 
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self.slot_req) if r is None]
@@ -1097,6 +1178,13 @@ class Engine:
         self.top_ks[slot] = req.top_k
         self.top_ps[slot] = req.top_p
         self.seeds[slot] = req.eff_seed
+        self.ban_ids[slot, :] = 2**31 - 1
+        if req.min_tokens > 0:
+            bs = sorted(self._ban_set(req))[:BAN_K]
+            self.ban_ids[slot, :len(bs)] = bs
+            self.ban_until[slot] = len(req.prompt_ids) + req.min_tokens
+        else:
+            self.ban_until[slot] = 0
         self.pres_pens[slot] = req.presence_penalty
         self.freq_pens[slot] = req.frequency_penalty
         if req.presence_penalty or req.frequency_penalty:
@@ -1140,7 +1228,9 @@ class Engine:
             jnp.int32(req.top_k), jnp.float32(req.top_p),
             logprobs=req.logprobs is not None,
             pages=jnp.asarray(self.table[slot]) if self.paged else None,
-            seed=jnp.uint32(req.eff_seed))
+            seed=jnp.uint32(req.eff_seed),
+            ban_ids=jnp.asarray(self.ban_ids[slot]),
+            ban_until=jnp.int32(self.ban_until[slot]))
         lp = None
         if req.logprobs is not None:
             self.cache, token, lp_t = out
@@ -1186,13 +1276,19 @@ class Engine:
             for i, (_, slot) in enumerate(batch):
                 tb[i] = self.table[slot]
             tables = jnp.asarray(tb)
+        ban_ids = np.full((n_bucket, BAN_K), 2**31 - 1, np.int32)
+        ban_until = np.zeros(n_bucket, np.int32)
+        for i, (_, slot) in enumerate(batch):
+            ban_ids[i] = self.ban_ids[slot]
+            ban_until[i] = self.ban_until[slot]
         t0 = time.monotonic()
         want_lp = self._want_logprobs([r for r, _ in batch])
         out = prefill_batch_step(
             self.cfg, self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(true_lens), jnp.asarray(slots), self._next_rng(),
             jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
-            logprobs=want_lp, tables=tables, seeds=jnp.asarray(seeds))
+            logprobs=want_lp, tables=tables, seeds=jnp.asarray(seeds),
+            ban_ids=jnp.asarray(ban_ids), ban_until=jnp.asarray(ban_until))
         lp_t = None
         if want_lp:
             self.cache, toks, lp_t = out
@@ -1274,7 +1370,9 @@ class Engine:
                           and not st.get("resumed")
                           and off + len(chunk) >= len(ids)),
                 pages=jnp.asarray(self.table[slot]) if self.paged else None,
-                seed=jnp.uint32(req.eff_seed))
+                seed=jnp.uint32(req.eff_seed),
+                ban_ids=jnp.asarray(self.ban_ids[slot]),
+                ban_until=jnp.int32(self.ban_until[slot]))
             if req.logprobs is not None and not st.get("resumed") \
                     and off + len(chunk) >= len(ids):
                 self.cache, token, lp_t = out
@@ -1412,6 +1510,9 @@ class Engine:
                 and not self._want_logprobs(self.slot_req)
                 and not (self.counts is not None
                          and (self.pres_pens.any() or self.freq_pens.any()))
+                # spec verify has no stop-suppression masking: fall back to
+                # plain decode while any slot's min_tokens ban is active
+                and not (self.ban_until > self.lengths).any()
                 and self.lengths[active].max(initial=0) + self.serving.spec_k
                 + 1 < self.max_len):
             proposal = self._propose_drafts(active)
@@ -1434,7 +1535,9 @@ class Engine:
             frequency=jnp.asarray(self.freq_pens) if want_pen else None,
             penalties=want_pen,
             table=jnp.asarray(self.table) if self.paged else None,
-            seeds=jnp.asarray(self.seeds))
+            seeds=jnp.asarray(self.seeds),
+            ban_ids=jnp.asarray(self.ban_ids),
+            ban_until=jnp.asarray(self.ban_until))
         # un-penalized dispatches return a dummy counts array — keep ours
         self.counts = new_counts if want_pen else real_counts
         lp_t = None
@@ -1482,7 +1585,9 @@ class Engine:
         if req.stream:
             req.out_queue.put(token)
 
-        hit_eos = (token in self._eos_set) and not req.ignore_eos
+        hit_eos = ((token in self._eos_set and not req.ignore_eos)
+                   or token in req.stop_token_ids) \
+            and len(req.generated) > req.min_tokens
         out_of_budget = (len(req.generated) >= req.max_tokens
                          or self.lengths[slot] + 1 >= self.max_len)
         if hit_eos or out_of_budget:
@@ -1506,6 +1611,7 @@ class Engine:
         self.temps[slot] = 0.0
         self.pres_pens[slot] = 0.0
         self.freq_pens[slot] = 0.0
+        self.ban_until[slot] = 0
         self._release_slot_pages(slot)
         self.sched.release(slot)
         self.metrics.active_requests.set(len(self._active_slots()))
@@ -1636,7 +1742,9 @@ class Engine:
                     jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
                     mesh=self.mesh, impl=self.serving.attention_impl,
                     table=jnp.asarray(self.table) if self.paged else None,
-                    seeds=jnp.asarray(self.seeds))
+                    seeds=jnp.asarray(self.seeds),
+                    ban_ids=jnp.asarray(self.ban_ids),
+                    ban_until=jnp.asarray(self.ban_until))
             return
 
         # Distinct token values per warmup request — identical prompts would
@@ -1717,7 +1825,9 @@ class Engine:
             counts=cnts, presence=jnp.asarray(self.pres_pens),
             frequency=jnp.asarray(self.freq_pens), penalties=True,
             table=jnp.asarray(self.table) if self.paged else None,
-            seeds=jnp.asarray(self.seeds))
+            seeds=jnp.asarray(self.seeds),
+            ban_ids=jnp.asarray(self.ban_ids),
+            ban_until=jnp.asarray(self.ban_until))
         del cnts
         # Logprobs program variants ('logprobs' is a static arg on every step
         # fn — distinct programs): one isolated request compiles the
@@ -1747,4 +1857,6 @@ class Engine:
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
             mesh=self.mesh, impl=self.serving.attention_impl,
             table=jnp.asarray(self.table) if self.paged else None,
-            seeds=jnp.asarray(self.seeds))
+            seeds=jnp.asarray(self.seeds),
+            ban_ids=jnp.asarray(self.ban_ids),
+            ban_until=jnp.asarray(self.ban_until))

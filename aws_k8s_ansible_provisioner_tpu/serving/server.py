@@ -287,6 +287,22 @@ class Handler(BaseHTTPRequestHandler):
         stops = body.get("stop") or []
         if isinstance(stops, str):
             stops = [stops]
+        # vLLM extras: stop_token_ids (token-level stops beside the string
+        # ones) and min_tokens (stop tokens masked from sampling until N
+        # tokens generated)
+        raw_stop_ids = body.get("stop_token_ids") or []
+        if not isinstance(raw_stop_ids, list):
+            # a string would silently iterate character-wise
+            return self._error(400, "'stop_token_ids' must be a list of "
+                                    "integers")
+        try:
+            stop_token_ids = tuple(int(t) for t in raw_stop_ids)
+            min_tokens = int(body.get("min_tokens", 0))
+        except (TypeError, ValueError):
+            return self._error(400, "'stop_token_ids' must be integers and "
+                                    "'min_tokens' an integer")
+        if min_tokens < 0:
+            return self._error(400, "'min_tokens' must be >= 0")
         stream = bool(body.get("stream", False))
         try:
             n_choices = int(body.get("n", 1))
@@ -380,6 +396,7 @@ class Handler(BaseHTTPRequestHandler):
                 top_k=top_k, top_p=top_p, stream=stream, logprobs=eng_lp,
                 presence_penalty=presence_penalty,
                 frequency_penalty=frequency_penalty,
+                stop_token_ids=stop_token_ids, min_tokens=min_tokens,
                 seed=None if seed is None else seed + i)
                 for i in range(best_of)]
         except ContextLengthExceeded as e:
@@ -388,6 +405,9 @@ class Handler(BaseHTTPRequestHandler):
             # question than the client asked).
             return self._error(400, str(e),
                                err_code="context_length_exceeded")
+        except ValueError as e:
+            # engine-side request validation (e.g. min_tokens ban-list cap)
+            return self._error(400, str(e))
 
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         if stream:

@@ -79,7 +79,8 @@ def main() -> int:
         with open(os.path.join(here, args.out), "a") as f:
             f.write(json.dumps(rec) + "\n")
         sys.stderr.write(f"sweep: -> {rec.get('value', rec.get('error'))}\n")
-    best = max((r for r in results if "value" in r),
+    # a total-failure bench record carries value 0.0 — not a real measurement
+    best = max((r for r in results if r.get("value")),
                key=lambda r: r["value"], default=None)
     print(json.dumps({"configs": len(results), "best": best}))
     return 0 if best else 1

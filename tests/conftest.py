@@ -33,6 +33,18 @@ jax.config.update("jax_platforms", "cpu")
 # calls, and the CPU fallback resolves to the XLA attention path.
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled executables between test modules: hundreds of live XLA
+    CPU programs in one process eventually segfault the compiler itself
+    (observed at ~85% of a serial full-suite run, independent of which file
+    lands there). Module granularity keeps module-scoped fixtures (shared
+    engines/params) coherent — their traced functions just recompile on
+    next use."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="session")
 def cpu_devices():
     devs = jax.devices()

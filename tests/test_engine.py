@@ -316,3 +316,45 @@ def test_awkward_cache_len_rounded_for_kernel(setup):
     odd = dataclasses.replace(serving, max_cache_len=509)
     engine = Engine(cfg, params, odd)
     assert engine.max_len == 512
+
+
+def test_stop_token_ids_and_min_tokens(setup):
+    """vLLM stop_token_ids: per-request token-level stops; min_tokens defers
+    ALL stops until that many tokens generated."""
+    cfg, params, serving = setup
+    eng = Engine(cfg, params, serving)
+
+    def run(**kw):
+        # seeded sampling: diverse tokens (greedy on random weights tends to
+        # repeat one token, which would make the stop point ambiguous) and
+        # deterministic across the three runs
+        r = eng.submit(Request(prompt_ids=[5, 9, 2], max_tokens=6,
+                               ignore_eos=True, temperature=1.2, seed=123,
+                               **kw))
+        while (any(s is not None for s in eng.slot_req) or eng.pending
+               or eng._chunk is not None):
+            eng.step()
+        return r
+
+    base = run()
+    assert len(base.generated) == 6
+    # a stop token whose FIRST occurrence is past position 0, so the
+    # truncation point is unambiguous even with repeated tokens
+    idx = next((i for i, t in enumerate(base.generated)
+                if i > 0 and t not in base.generated[:i]), None)
+    if idx is None:
+        pytest.skip("degenerate stream: every token repeats position 0")
+    stop_tok = base.generated[idx]
+    stopped = run(stop_token_ids=(stop_tok,))
+    # ignore_eos does NOT disable per-request stop_token_ids (vLLM semantics)
+    assert stopped.finish_reason == "stop"
+    assert stopped.generated == base.generated[:idx + 1]
+    # min_tokens MASKS the stop token from sampling (vLLM semantics): it is
+    # never produced while suppressed — the stream DIVERGES at the banned
+    # position instead of carrying a dead stop token — and generation runs
+    # to the budget
+    deferred = run(stop_token_ids=(stop_tok,), min_tokens=6)
+    assert len(deferred.generated) == 6
+    assert deferred.finish_reason == "length"
+    assert stop_tok not in deferred.generated
+    assert deferred.generated[:idx] == base.generated[:idx]

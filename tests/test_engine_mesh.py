@@ -208,3 +208,33 @@ def test_mesh_sp1_allows_unaligned_cache(setup):
     prompts = [[5, 7, 11]]
     single = Engine(cfg, params, odd)
     assert _run_all(engine, prompts) == _run_all(single, prompts)
+
+
+def test_tp_mesh_keeps_paged_cache(setup):
+    """tp shards only the pool's head axis, so paging (page-gated admission,
+    on-demand growth) must survive under a tp mesh — the Qwen3-8B/v5e-8
+    flagship config; dp/sp meshes fall back to the dense layout."""
+    cfg, params, serving = setup
+    tp_eng = Engine(cfg, params, serving, mesh=_mesh(1, 2))
+    assert tp_eng.paged and tp_eng.cache["k"].ndim == 5
+    assert tp_eng.cache["k"].shape[1] == \
+        serving.max_decode_slots * (tp_eng.max_len // serving.page_size) + 1
+    dp_eng = Engine(cfg, params, serving, mesh=_mesh(2, 1))
+    assert not dp_eng.paged
+
+    # page-gated admission works under the tp mesh: a pool of one window
+    # serializes two prompts over 4 free slots
+    small_pool = dataclasses.replace(serving, kv_pool_pages=4, page_size=8,
+                                     max_cache_len=32,
+                                     prefill_buckets=(8, 16, 32))
+    eng = Engine(cfg, params, small_pool, mesh=_mesh(1, 2))
+    a = eng.submit(Request(prompt_ids=[3] * 17, max_tokens=2,
+                           ignore_eos=True))     # 3 pages
+    b = eng.submit(Request(prompt_ids=[4] * 9, max_tokens=2,
+                           ignore_eos=True))     # 2 pages > 1 left: waits
+    eng.step()
+    assert sum(1 for r in eng.slot_req if r is not None) == 1
+    for _ in range(10000):
+        if not eng.step():
+            break
+    assert len(a.generated) == 2 and len(b.generated) == 2

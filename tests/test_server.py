@@ -371,3 +371,11 @@ def test_best_of_smaller_than_n_rejected(server):
         _post(server + "/v1/completions", {
             "model": MODEL_NAME, "prompt": "x", "n": 3, "best_of": 2})
     assert e.value.code == 400
+
+
+def test_min_tokens_invalid_rejected(server):
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server + "/v1/completions", {
+            "model": MODEL_NAME, "prompt": "x", "min_tokens": -1})
+    assert e.value.code == 400
