@@ -151,6 +151,24 @@ LOGPROB_K = 8
 # out-of-vocab id, which the masking scatter DROPS.
 BAN_K = 8
 
+# Static width of the per-slot OpenAI ``logit_bias`` list (OpenAI caps the
+# map at 300 entries; vLLM-grade clients rarely exceed a few dozen — the
+# server rejects beyond this). Padding ids are out-of-vocab and DROP.
+BIAS_K = 64
+
+
+def _apply_logit_bias(logits: jnp.ndarray, bias_ids, bias_vals) -> jnp.ndarray:
+    """OpenAI ``logit_bias``: add per-request offsets to selected token
+    logits before any sampling (greedy included — -100/+100 act as ban/
+    force, the documented semantics). Always-on scatter-add: unbiased slots
+    carry out-of-vocab ids that drop. bias_ids: [B, BIAS_K] int32;
+    bias_vals: [B, BIAS_K] f32."""
+    if bias_ids is None:
+        return logits
+    B = logits.shape[0]
+    return logits.at[jnp.arange(B)[:, None], bias_ids].add(
+        bias_vals.astype(logits.dtype), mode="drop")
+
 
 def _mask_banned(logits: jnp.ndarray, ban_ids, ban_until, lens) -> jnp.ndarray:
     """vLLM ``min_tokens`` semantics: while a slot's context length is below
