@@ -609,8 +609,11 @@ class ServingConfig:
     # a shared physical page pool + per-slot block tables replace the
     # slot-contiguous per-slot reservation, so HBM cost tracks ACTUAL
     # sequence lengths and admission is gated by free pages, not free slots.
-    # Single-device path — the dp/tp/sp mesh serves the dense layout (a
-    # per-dp-group pool is future work); the engine picks automatically.
+    # Composes with tp meshes (heads sharded over the pool) and dp meshes
+    # (pool page axis partitioned per dp group, per-group host allocators);
+    # only sp meshes fall back to the dense layout (a page is a contiguous
+    # row run — splitting it across sequence shards defeats paging). The
+    # engine picks automatically.
     paged: bool = True
     # Physical pages in the pool. 0 = max_decode_slots * ceil(max_cache_len /
     # page_size) — the same HBM as the dense cache, useful as a drop-in.
@@ -657,6 +660,12 @@ class ServingConfig:
     spec_k: int = 4
     spec_ngram: int = 3
     max_tokens_default: int = 256
+    # Seed for the engine's DERIVED sampling seeds (requests without an
+    # OpenAI ``seed``). None = entropy from os.urandom at engine start, so
+    # restarts and replicas draw independently (the vLLM/OpenAI
+    # nondeterministic default — ADVICE r3). Set an int for reproducible
+    # harnesses (the dryrun parity run and tests pin 0).
+    derived_seed: object = None
     dtype: str = "bfloat16"
     # KV-cache storage dtype: "auto" follows ``dtype``; "int8" stores K/V rows
     # quantized with per-(layer, slot, head, row) float32 scales — half the

@@ -136,19 +136,24 @@ def cache_pspecs(quant: bool = False) -> dict:
 
 
 def pool_pspecs(quant: bool = False) -> dict:
-    """Paged KV pool [L, pages, Hkv, page, D]: kv heads over tp — the ONLY
-    sharded axis. Page identity is head-independent, so the block tables,
-    lengths, and the host allocator are replicated/shared verbatim across tp
-    shards; a tp group serves one paged engine with each chip holding its
-    heads' slice of every page (serving/paged_kv.py; the dp/sp axes keep the
-    dense layout — per-dp-group pools are future work)."""
+    """Paged KV pool [L, pages, Hkv, page, D]: PAGES over dp, kv heads over
+    tp. Page identity is head-independent, so block tables, lengths, and the
+    host allocators are tp-shard-invariant — each tp chip holds its heads'
+    slice of every page. The dp axis partitions the page POOL itself: slots
+    are dp-sharded, each dp group owns one page-axis partition with its own
+    host allocator, and a slot's table only ever references its group's
+    partition (Engine writes GLOBAL ids = local + group * partition; the
+    shard_map kernels subtract their partition base). On dp=1 meshes the dp
+    axis has size 1 and this degenerates to the tp-only layout. Only sp
+    keeps the dense cache (a page is a contiguous row run — splitting it
+    across sequence shards defeats paging)."""
     specs = {
-        "k": P(None, None, "tp", None, None),
-        "v": P(None, None, "tp", None, None),
+        "k": P(None, "dp", "tp", None, None),
+        "v": P(None, "dp", "tp", None, None),
     }
     if quant:
-        specs["ks"] = P(None, None, "tp", None)
-        specs["vs"] = P(None, None, "tp", None)
+        specs["ks"] = P(None, "dp", "tp", None)
+        specs["vs"] = P(None, "dp", "tp", None)
     return specs
 
 

@@ -114,6 +114,11 @@ class Request:
     # vLLM ``min_tokens``: suppress ALL stop tokens (eos + stop_token_ids)
     # until this many tokens have been generated (budget still caps).
     min_tokens: int = 0
+    # OpenAI ``logit_bias``: ((token_id, bias), ...) pairs added to the
+    # logits before every sampling decision (greedy included — ±100 act as
+    # force/ban, the documented semantics). Server normalizes the JSON map;
+    # () = off. At most BIAS_K entries (submit() validates).
+    logit_bias: tuple = ()
     id: int = field(default_factory=lambda: next(_REQUEST_IDS))
     # Filled in by the engine:
     generated: List[int] = field(default_factory=list)
@@ -234,7 +239,8 @@ def _restore_count_row(counts, slot, row):
          donate_argnums=(2,))
 def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
                  temperature, top_k, top_p, logprobs: bool = False,
-                 pages=None, seed=None, ban_ids=None, ban_until=None):
+                 pages=None, seed=None, ban_ids=None, ban_until=None,
+                 bias_ids=None, bias_vals=None):
     """Prefill one prompt into one slot; returns (cache, first sampled token).
 
     tokens: [1, T] right-padded to a bucket; true_len: scalar valid length;
@@ -252,6 +258,8 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
                                      window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = jnp.take(logits[0], true_len - 1, axis=0)[None]   # [1, V]
+    if bias_ids is not None:
+        last = _apply_logit_bias(last, bias_ids[None], bias_vals[None])
     if ban_ids is not None:
         last = _mask_banned(last, ban_ids[None], ban_until[None],
                             true_len[None])
@@ -272,7 +280,8 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
 def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
                        slots, rng, temperature, top_k, top_p,
                        logprobs: bool = False, tables=None, seeds=None,
-                       ban_ids=None, ban_until=None):
+                       ban_ids=None, ban_until=None,
+                       bias_ids=None, bias_vals=None):
     """Prefill N prompts into N slots in ONE dispatch.
 
     tokens: [N, T] right-padded to a (row, length) bucket; true_lens/slots/
@@ -293,6 +302,8 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
                                            window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = logits[jnp.arange(N), true_lens - 1]            # [N, V]
+    if bias_ids is not None:
+        last = _apply_logit_bias(last, bias_ids, bias_vals)
     if ban_ids is not None:
         last = _mask_banned(last, ban_ids, ban_until, true_lens)
     keys = per_slot_keys(seeds, true_lens) if seeds is not None else rng
@@ -307,7 +318,8 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
 def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
                        chunk_len, rng, temperature, top_k, top_p,
                        logprobs: bool = False, pages=None, seed=None,
-                       ban_ids=None, ban_until=None):
+                       ban_ids=None, ban_until=None,
+                       bias_ids=None, bias_vals=None):
     """Prefill ONE chunk of a long prompt; decode interleaves between chunks.
 
     tokens: [1, C] (the chunk, right-padded on the final chunk); start: row
@@ -328,6 +340,8 @@ def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
                                            window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = jnp.take(logits[0], chunk_len - 1, axis=0)[None]  # [1, V]
+    if bias_ids is not None:
+        last = _apply_logit_bias(last, bias_ids[None], bias_vals[None])
     if ban_ids is not None:
         last = _mask_banned(last, ban_ids[None], ban_until[None],
                             (start + chunk_len)[None])
@@ -353,7 +367,8 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
                  impl: str = "auto", logprobs: bool = False,
                  counts=None, presence=None, frequency=None,
                  penalties: bool = False, table=None, seeds=None,
-                 ban_ids=None, ban_until=None):
+                 ban_ids=None, ban_until=None, bias_ids=None,
+                 bias_vals=None):
     """``n_steps`` fused decode steps for every slot, one device dispatch.
 
     tokens/lengths/sampling params: [B]. Returns (cache, out [n_steps, B]).
@@ -392,8 +407,12 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
             # repeat is penalized immediately, not at the next dispatch)
             step_logits = apply_penalties(step_logits, cnts, presence,
                                           frequency)
-        # min_tokens stop suppression evaluates PER SUBSTEP (lens rides the
-        # carry), so a ban can expire mid-horizon exactly when vLLM's would
+        # OpenAI logit_bias: additive on logits before every sampling
+        # decision, then min_tokens stop suppression (mask wins: a +100 bias
+        # on eos must not resurrect a banned stop token). The ban evaluates
+        # PER SUBSTEP (lens rides the carry), so it can expire mid-horizon
+        # exactly when vLLM's would.
+        step_logits = _apply_logit_bias(step_logits, bias_ids, bias_vals)
         step_logits = _mask_banned(step_logits, ban_ids, ban_until, lens)
         # ctr = lens + 1 = the context length this draw extends TO: distinct
         # from the prefill draw's ctr (= prompt length) and equal to what a
@@ -417,11 +436,11 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
     return cache, counts, out
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("impl",),
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("impl", "mesh"),
          donate_argnums=(3,))
 def spec_decode_step(cfg: ModelConfig, R: int, params, cache, tokens,
                      lengths, rng, temperature, top_k, top_p,
-                     impl: str = "auto", table=None, seeds=None):
+                     impl: str = "auto", table=None, seeds=None, mesh=None):
     """Speculative verify: R tokens per slot in ONE dispatch.
 
     tokens: [B, R] = [last accepted token, spec_k prompt-lookup drafts];
@@ -442,9 +461,10 @@ def spec_decode_step(cfg: ModelConfig, R: int, params, cache, tokens,
     positions = lengths[:, None] + jnp.arange(R, dtype=jnp.int32)[None, :]
     if table is not None:
         attend = make_spec_attend_carry_paged(lengths, table, impl=impl,
+                                              mesh=mesh,
                                               window=cfg.sliding_window)
     else:
-        attend = make_spec_attend_carry(lengths, impl=impl,
+        attend = make_spec_attend_carry(lengths, impl=impl, mesh=mesh,
                                         window=cfg.sliding_window)
     logits, cache = model_forward_carry(params, cfg, tokens, positions,
                                         cache, attend)
@@ -557,14 +577,31 @@ class Engine:
         # True paged KV: shared page pool + block tables. Composes with tp
         # (and ep) meshes — the pool shards only its KV-HEAD axis, so page
         # identity, tables, and the host allocator are shard-invariant
-        # (parallel/sharding.pool_pspecs). dp shards SLOTS (each group would
-        # need its own pool partition — future work) and sp shards the
-        # sequence axis (incompatible with the pool layout), so those keep
-        # the dense slot-contiguous cache.
+        # (parallel/sharding.pool_pspecs) — AND with dp meshes (VERDICT r3
+        # next #6): the pool's PAGE axis shards over dp, giving each
+        # dp group its own pool partition with a per-group host allocator
+        # (slots are dp-sharded, so a slot's pages always live in its own
+        # group's partition; prefix sharing is group-local). Only sp keeps
+        # the dense layout: it shards the SEQUENCE axis, and a page is a
+        # contiguous row run — splitting pages across sp shards would
+        # reintroduce the cross-shard row addressing paging exists to avoid.
         self.paged = bool(serving.paged) and (
+            self.mesh is None or self.mesh.shape.get("sp", 1) == 1)
+        # Speculation composes with pure-tp meshes: every tp shard executes
+        # the identical token stream, so the data-dependent accept length is
+        # shard-invariant (vLLM runs spec decode under TP; VERDICT r3 missing
+        # #2). dp shards SLOTS (per-group accept lengths would desync the
+        # groups' fused horizons) and sp's partial-softmax merge has no spec
+        # variant, so those keep plain decode.
+        self._spec_mesh_ok = (
             self.mesh is None
             or (self.mesh.shape.get("dp", 1) == 1
                 and self.mesh.shape.get("sp", 1) == 1))
+        # Alternation flag: after a spec dispatch that skipped ineligible
+        # slots (logprobs/penalties/min_tokens — _slot_spec_ineligible), the
+        # next dispatch takes the plain fused path so those slots advance
+        # every other step instead of starving.
+        self._spec_plain_due = False
         if self.paged:
             from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
 
@@ -578,20 +615,43 @@ class Engine:
                                  f"{'int8' if self.kv_quant else 'bf16'} "
                                  f"paged kernels")
             self.pages_per_slot = -(-self.max_len // ps)
+            # dp groups: slots split evenly over dp (divisibility enforced
+            # above); each group owns one partition of the pool's page axis
+            # and its own host allocator working in LOCAL page ids. The
+            # device-side table holds GLOBAL ids (local + group * partition),
+            # so the GSPMD paths address the full pool directly and the
+            # shard_map kernels subtract their own partition base.
+            self.dp_groups = (self.mesh.shape.get("dp", 1)
+                              if self.mesh is not None else 1)
+            self._slots_per_group = self.num_slots // self.dp_groups
             pool_pages = serving.kv_pool_pages \
                 or self.num_slots * self.pages_per_slot
-            if pool_pages < self.pages_per_slot:
-                # a lone max-length request must always be able to grow to
-                # the window, or preemption would spin on itself
+            if serving.kv_pool_pages and pool_pages % self.dp_groups:
+                # an explicit pool size must split exactly — silently
+                # dropping the remainder would skew the operator's capacity
+                # math by up to dp-1 pages (review r4)
                 raise ValueError(
-                    f"kv_pool_pages={pool_pages} < pages for one full "
-                    f"window ({self.pages_per_slot})")
-            # +1: physical page 0 is the SCRATCH page — every idle slot's
-            # table points at it, so the decode programs' per-slot garbage
-            # row writes can never land in a page another slot owns.
+                    f"kv_pool_pages={pool_pages} must be divisible by the "
+                    f"dp group count ({self.dp_groups})")
+            group_pages = pool_pages // self.dp_groups
+            if group_pages < self.pages_per_slot:
+                # a lone max-length request must always be able to grow to
+                # the window IN ITS OWN GROUP, or preemption would spin on
+                # itself
+                raise ValueError(
+                    f"kv_pool_pages={pool_pages} over {self.dp_groups} dp "
+                    f"group(s) gives {group_pages}/group < pages for one "
+                    f"full window ({self.pages_per_slot})")
+            # +1 per group: local physical page 0 is that group's SCRATCH
+            # page — every idle slot's table points at its group's scratch,
+            # so the decode programs' per-slot garbage row writes can never
+            # land in a page another slot owns.
+            self._group_pages = group_pages + 1     # pool partition size
+            total_pages = self.dp_groups * self._group_pages
             if self.mesh is not None:
-                # born sharded (heads over tp): no device ever holds the
-                # full pool — same rationale as the dense mesh cache below
+                # born sharded (pages over dp, heads over tp): no device ever
+                # holds the full pool — same rationale as the dense mesh
+                # cache below
                 from jax.sharding import NamedSharding
 
                 from aws_k8s_ansible_provisioner_tpu.parallel.sharding import (
@@ -601,15 +661,23 @@ class Engine:
                           for name, spec in
                           pool_pspecs(self.kv_quant).items()}
                 self.cache = jax.jit(
-                    lambda: pkv.init_pool(cfg, pool_pages + 1, ps, dtype,
+                    lambda: pkv.init_pool(cfg, total_pages, ps, dtype,
                                           quant=self.kv_quant),
                     out_shardings=out_sh)()
             else:
-                self.cache = pkv.init_pool(cfg, pool_pages + 1, ps, dtype,
+                self.cache = pkv.init_pool(cfg, total_pages, ps, dtype,
                                            quant=self.kv_quant)
-            self.allocator = pkv.PagePool(pool_pages + 1, ps, first_page=1)
-            self.table = np.zeros((self.num_slots, self.pages_per_slot),
-                                  np.int32)
+            self.allocators = [pkv.PagePool(self._group_pages, ps,
+                                            first_page=1)
+                               for _ in range(self.dp_groups)]
+            # per-slot global id of its group's scratch page (group 0's is 0,
+            # preserving the single-device layout)
+            self._scratch = np.repeat(
+                np.arange(self.dp_groups, dtype=np.int32)
+                * self._group_pages, self._slots_per_group)
+            self.table = np.broadcast_to(
+                self._scratch[:, None],
+                (self.num_slots, self.pages_per_slot)).copy()
             self._slot_pages: List[List[int]] = [[] for _ in
                                                  range(self.num_slots)]
             # req id -> prompt+generated context for preemption resume
@@ -640,13 +708,19 @@ class Engine:
 
         self.metrics = EngineMetrics()
         self._rng = jax.random.PRNGKey(0)
-        # Derived sampling seeds for requests that don't set OpenAI `seed`:
-        # a per-engine deterministic stream, so identical submission
-        # sequences on two engines (the dryrun parity harness) draw
-        # identically — matching the old shared-rng-chain behavior.
+        # Derived sampling seeds for requests that don't set OpenAI `seed`.
+        # Default (derived_seed=None): entropy from os.urandom, so engine
+        # restarts and sibling replicas draw independently — the vLLM/OpenAI
+        # nondeterministic default (ADVICE r3: Random(0) made every restart
+        # replay the identical unseeded sample sequence). Harnesses that
+        # need two engines to draw identically (dryrun parity, tests) pin an
+        # int derived_seed.
+        import os as _os
         import random as _random
 
-        self._py_rng = _random.Random(0)
+        self._py_rng = _random.Random(
+            int.from_bytes(_os.urandom(8), "little")
+            if serving.derived_seed is None else int(serving.derived_seed))
         # Host-side slot state (numpy mirrors of the device vectors).
         self.lengths = np.zeros(self.num_slots, np.int32)
         self.last_token = np.zeros(self.num_slots, np.int32)
@@ -659,6 +733,13 @@ class Engine:
         # while the slot's context length < ban_until (prompt + min_tokens)
         self.ban_ids = np.full((self.num_slots, BAN_K), 2**31 - 1, np.int32)
         self.ban_until = np.zeros(self.num_slots, np.int32)
+        # OpenAI logit_bias: per-slot (ids, vals) rows, always-on scatter-add
+        # in every sampling step (padding ids are out-of-vocab and drop) —
+        # the same no-program-variant mechanism as the ban rows above.
+        # _bias_n tracks which slots have live bias (spec eligibility).
+        self.bias_ids = np.full((self.num_slots, BIAS_K), 2**31 - 1, np.int32)
+        self.bias_vals = np.zeros((self.num_slots, BIAS_K), np.float32)
+        self._bias_n = np.zeros(self.num_slots, np.int32)
         self.pres_pens = np.zeros(self.num_slots, np.float32)
         self.freq_pens = np.zeros(self.num_slots, np.float32)
         # [num_slots, V] generated-token counts, allocated lazily on the
@@ -794,6 +875,21 @@ class Engine:
         return n >= max(1, self.serving.prefix_cache_payback_rows)
 
     # -- paged-KV lifecycle -------------------------------------------------
+    # Slots map to dp groups contiguously (slot // slots_per_group); each
+    # group's allocator works in LOCAL page ids (0 = its scratch page) and
+    # the device table stores GLOBAL ids = local + group * _group_pages.
+    # Single-device (dp_groups == 1) degenerates to the original layout.
+
+    def _group(self, slot: int) -> int:
+        return slot // self._slots_per_group
+
+    def _alloc(self, slot: int):
+        """The allocator owning this slot's dp group's pool partition."""
+        return self.allocators[self._group(slot)]
+
+    def _gbase(self, slot: int) -> int:
+        """Global page id of this slot's group's partition base."""
+        return self._group(slot) * self._group_pages
 
     def _paged_admit(self, req: Request, slot: int, isolated: bool):
         """Assign pages to an admitted request: page-level prefix reuse
@@ -811,28 +907,30 @@ class Engine:
         resumed = ctx is not None
         ids = list(ctx) if resumed else list(req.prompt_ids)
         ps = self.serving.page_size
+        allocator = self._alloc(slot)
         matched: List[int] = []
         n = 0
         if self.serving.prefix_cache and (isolated or resumed
                                           or self._should_chunk(req)):
-            matched, n = self.allocator.lookup_prefix(ids)
+            matched, n = allocator.lookup_prefix(ids)
             # the final token must run through prefill to produce the first
             # sampled token — cap reuse one token short of the prompt
             while n > len(ids) - 1:
                 matched.pop()
                 n -= ps
         for pid in matched:
-            self.allocator.retain(pid)
+            allocator.retain(pid)
         need = -(-len(ids) // ps) - len(matched)
-        fresh = self.allocator.alloc(need) if need > 0 else []
+        fresh = allocator.alloc(need) if need > 0 else []
         if fresh is None:
-            self.allocator.release_all(matched)
+            allocator.release_all(matched)
             return None
         self._resume_ctx.pop(req.id, None)
         pages = matched + list(fresh)
         self._slot_pages[slot] = pages
-        self.table[slot, :] = 0
-        self.table[slot, :len(pages)] = pages
+        self.table[slot, :] = self._scratch[slot]
+        self.table[slot, :len(pages)] = \
+            np.asarray(pages, np.int32) + self._gbase(slot)
         self._seq_counter += 1
         self._admit_seq[slot] = self._seq_counter
         if n > 0:
@@ -856,11 +954,12 @@ class Engine:
             # unindexed pages go straight back to the free list at release
             return
         ps = self.serving.page_size
+        allocator = self._alloc(slot)
         pages = self._slot_pages[slot]
         n_valid = len(ids) if n_valid is None else n_valid
         key = None
         for p in range(min(n_valid // ps, len(pages))):
-            key = self.allocator.index_page(
+            key = allocator.index_page(
                 pages[p], key, tuple(ids[p * ps:(p + 1) * ps]))
 
     def _release_slot_pages(self, slot: int):
@@ -870,16 +969,16 @@ class Engine:
         pages another request now owns."""
         if not self.paged:
             return
-        self.allocator.release_all(self._slot_pages[slot])
+        self._alloc(slot).release_all(self._slot_pages[slot])
         self._slot_pages[slot] = []
-        self.table[slot, :] = 0
+        self.table[slot, :] = self._scratch[slot]
         self.lengths[slot] = 0
         self._pages_gauges()
 
     def _pages_gauges(self):
-        st = self.allocator.stats()
-        self.metrics.kv_pages_total.set(st["pages_total"])
-        self.metrics.kv_pages_in_use.set(st["pages_live"])
+        sts = [a.stats() for a in self.allocators]
+        self.metrics.kv_pages_total.set(sum(s["pages_total"] for s in sts))
+        self.metrics.kv_pages_in_use.set(sum(s["pages_live"] for s in sts))
 
     def _ensure_pages(self, new_rows: int) -> bool:
         """Grow every active slot's page run to cover rows
@@ -904,15 +1003,20 @@ class Engine:
             pages = self._slot_pages[slot]
             while len(pages) < -(-rows // ps):
                 need = -(-rows // ps) - len(pages)
-                got = self.allocator.alloc(need)
+                got = self._alloc(slot).alloc(need)
                 if got is not None:
-                    self.table[slot, len(pages):len(pages) + need] = got
+                    self.table[slot, len(pages):len(pages) + need] = \
+                        np.asarray(got, np.int32) + self._gbase(slot)
                     pages.extend(got)
                     break
-                # newest admission overall yields — when that is this slot
-                # itself (it is the youngest and still starving), it gets
-                # requeued rather than taking pages from older requests
-                victim = max(self._active_slots(), default=None,
+                # newest admission IN THIS SLOT'S GROUP yields — pages are
+                # group-local, so preempting another group frees nothing for
+                # this slot. When the victim is this slot itself (youngest in
+                # its group and still starving), it gets requeued rather than
+                # taking pages from older requests.
+                victim = max((s for s in self._active_slots()
+                              if self._group(s) == self._group(slot)),
+                             default=None,
                              key=lambda s: self._admit_seq[s])
                 if victim is None:
                     break
@@ -939,6 +1043,9 @@ class Engine:
         self.pres_pens[slot] = 0.0
         self.freq_pens[slot] = 0.0
         self.ban_until[slot] = 0
+        self.bias_ids[slot, :] = 2**31 - 1
+        self.bias_vals[slot, :] = 0.0
+        self._bias_n[slot] = 0
         self._release_slot_pages(slot)
         self.sched.release(slot)
         remaining = max(1, req.max_tokens - len(req.generated))
@@ -964,6 +1071,9 @@ class Engine:
                 raise ValueError(
                     f"min_tokens suppression supports at most {BAN_K} stop "
                     f"tokens (eos set + stop_token_ids = {n_ban})")
+        if len(req.logit_bias) > BIAS_K:
+            raise ValueError(f"logit_bias supports at most {BIAS_K} entries "
+                             f"(got {len(req.logit_bias)})")
         budget = self.max_len - len(req.prompt_ids) - 1
         if req.max_tokens > budget:
             req.max_tokens = max(1, budget)
@@ -993,6 +1103,27 @@ class Engine:
         exactly the set _emit would stop on."""
         base = set() if req.ignore_eos else set(self._eos_set)
         return base | set(req.stop_token_ids)
+
+    def _fill_sampling_rows(self, req: Request, slot: int):
+        """Populate the slot's min_tokens ban and logit_bias rows from the
+        request. Called BEFORE the prefill dispatch (so the FIRST sampled
+        token already honors both — filling only at _activate would let it
+        escape suppression/bias) and again at _activate (idempotent; covers
+        the preemption-resume path)."""
+        self.ban_ids[slot, :] = 2**31 - 1
+        if req.min_tokens > 0:
+            bs = sorted(self._ban_set(req))[:BAN_K]
+            self.ban_ids[slot, :len(bs)] = bs
+            self.ban_until[slot] = len(req.prompt_ids) + req.min_tokens
+        else:
+            self.ban_until[slot] = 0
+        self.bias_ids[slot, :] = 2**31 - 1
+        self.bias_vals[slot, :] = 0.0
+        n = len(req.logit_bias)
+        self._bias_n[slot] = n
+        if n:
+            self.bias_ids[slot, :n] = [t for t, _ in req.logit_bias]
+            self.bias_vals[slot, :n] = [v for _, v in req.logit_bias]
 
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self.slot_req) if r is None]
@@ -1049,11 +1180,17 @@ class Engine:
         batch: List = []
         chunk_next = None
         while len(batch) < max(1, self.serving.max_prefill_batch):
-            # Paged admission is gated by the allocator's headroom (free +
+            # Paged admission is gated by the allocators' headroom (free +
             # evictable pages) — capacity scales with ACTUAL lengths, the
-            # vLLM on-demand-block behavior (VERDICT r2 missing #2).
+            # vLLM on-demand-block behavior (VERDICT r2 missing #2). With dp
+            # groups the gate is the BEST group's headroom (the scheduler
+            # picks the slot, not the group): when it hands a slot from a
+            # fuller group, _paged_admit fails and the requeue below retries
+            # — the freed slot rotates to the back of the free deque, so
+            # retries walk onto other groups' slots.
             action = self.sched.pop_admission(
-                self.allocator.free_pages if self.paged else None)
+                max(a.free_pages for a in self.allocators)
+                if self.paged else None)
             if action is None:
                 break
             if action[0] == "cancelled":
@@ -1196,13 +1333,7 @@ class Engine:
         self.top_ks[slot] = req.top_k
         self.top_ps[slot] = req.top_p
         self.seeds[slot] = req.eff_seed
-        self.ban_ids[slot, :] = 2**31 - 1
-        if req.min_tokens > 0:
-            bs = sorted(self._ban_set(req))[:BAN_K]
-            self.ban_ids[slot, :len(bs)] = bs
-            self.ban_until[slot] = len(req.prompt_ids) + req.min_tokens
-        else:
-            self.ban_until[slot] = 0
+        self._fill_sampling_rows(req, slot)
         self.pres_pens[slot] = req.presence_penalty
         self.freq_pens[slot] = req.frequency_penalty
         if req.presence_penalty or req.frequency_penalty:
@@ -1238,6 +1369,7 @@ class Engine:
         bucket = self._bucket_for(len(ids))
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :len(ids)] = ids
+        self._fill_sampling_rows(req, slot)
         t0 = time.monotonic()
         out = prefill_step(
             self.cfg, self.params, self.cache,
@@ -1248,7 +1380,9 @@ class Engine:
             pages=jnp.asarray(self.table[slot]) if self.paged else None,
             seed=jnp.uint32(req.eff_seed),
             ban_ids=jnp.asarray(self.ban_ids[slot]),
-            ban_until=jnp.int32(self.ban_until[slot]))
+            ban_until=jnp.int32(self.ban_until[slot]),
+            bias_ids=jnp.asarray(self.bias_ids[slot]),
+            bias_vals=jnp.asarray(self.bias_vals[slot]))
         lp = None
         if req.logprobs is not None:
             self.cache, token, lp_t = out
@@ -1296,9 +1430,14 @@ class Engine:
             tables = jnp.asarray(tb)
         ban_ids = np.full((n_bucket, BAN_K), 2**31 - 1, np.int32)
         ban_until = np.zeros(n_bucket, np.int32)
-        for i, (_, slot) in enumerate(batch):
+        bias_ids = np.full((n_bucket, BIAS_K), 2**31 - 1, np.int32)
+        bias_vals = np.zeros((n_bucket, BIAS_K), np.float32)
+        for i, (req, slot) in enumerate(batch):
+            self._fill_sampling_rows(req, slot)
             ban_ids[i] = self.ban_ids[slot]
             ban_until[i] = self.ban_until[slot]
+            bias_ids[i] = self.bias_ids[slot]
+            bias_vals[i] = self.bias_vals[slot]
         t0 = time.monotonic()
         want_lp = self._want_logprobs([r for r, _ in batch])
         out = prefill_batch_step(
@@ -1306,7 +1445,8 @@ class Engine:
             jnp.asarray(true_lens), jnp.asarray(slots), self._next_rng(),
             jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
             logprobs=want_lp, tables=tables, seeds=jnp.asarray(seeds),
-            ban_ids=jnp.asarray(ban_ids), ban_until=jnp.asarray(ban_until))
+            ban_ids=jnp.asarray(ban_ids), ban_until=jnp.asarray(ban_until),
+            bias_ids=jnp.asarray(bias_ids), bias_vals=jnp.asarray(bias_vals))
         lp_t = None
         if want_lp:
             self.cache, toks, lp_t = out
@@ -1330,6 +1470,7 @@ class Engine:
         copy); the walk starts at the reuse offset, over ``ids`` — which is
         prompt + generated for a preemption resume.
         """
+        self._fill_sampling_rows(req, slot)   # before the first chunk dispatch
         if self.paged:
             _, ids, off, resumed = pref if pref is not None \
                 else ("paged", list(req.prompt_ids), 0, False)
@@ -1390,7 +1531,9 @@ class Engine:
                 pages=jnp.asarray(self.table[slot]) if self.paged else None,
                 seed=jnp.uint32(req.eff_seed),
                 ban_ids=jnp.asarray(self.ban_ids[slot]),
-                ban_until=jnp.int32(self.ban_until[slot]))
+                ban_until=jnp.int32(self.ban_until[slot]),
+                bias_ids=jnp.asarray(self.bias_ids[slot]),
+                bias_vals=jnp.asarray(self.bias_vals[slot]))
             if req.logprobs is not None and not st.get("resumed") \
                     and off + len(chunk) >= len(ids):
                 self.cache, token, lp_t = out
@@ -1454,9 +1597,29 @@ class Engine:
             proposed[slot] = int(cont.size)
         return (drafts, proposed) if proposed else None
 
+    def _slot_spec_ineligible(self, slot: int) -> bool:
+        """True when this slot's request needs a plain-path-only feature:
+        logprobs (verify computes no logprob tensors), active presence/
+        frequency penalties (verify sampling applies none), an active
+        min_tokens ban (verify has no stop-suppression masking), or a
+        logit_bias (verify argmax ignores it). Such slots
+        are skipped by the verify dispatch and served by the alternating
+        plain step — per-slot fallback, not batch-wide."""
+        req = self.slot_req[slot]
+        return (req.logprobs is not None
+                or (self.counts is not None
+                    and bool(self.pres_pens[slot] or self.freq_pens[slot]))
+                or self.ban_until[slot] > self.lengths[slot]
+                or self._bias_n[slot] > 0)
+
     def _do_spec_decode(self, active: List[int], drafts,
-                        proposed: dict) -> None:
-        """One speculative verify dispatch: up to spec_k + 1 tokens per slot."""
+                        proposed: dict, skip=frozenset()) -> None:
+        """One speculative verify dispatch: up to spec_k + 1 tokens per slot.
+
+        ``skip`` slots participate in the dispatch (the batch shape is fixed
+        and their surplus K/V row writes follow the standard rewrite
+        invariant) but emit nothing — their tokens come from the next plain
+        step, which applies the features the verify pass lacks."""
         t0 = time.monotonic()
         R = self.serving.spec_k + 1
         tokens = np.concatenate([self.last_token[:, None], drafts], axis=1)
@@ -1466,13 +1629,15 @@ class Engine:
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
             jnp.asarray(self.top_ps), impl=self.serving.attention_impl,
             table=jnp.asarray(self.table) if self.paged else None,
-            seeds=jnp.asarray(self.seeds))
+            seeds=jnp.asarray(self.seeds), mesh=self.mesh)
         out = np.asarray(out)
         accepted = np.asarray(accepted)
         dt = time.monotonic() - t0
         self.metrics.device_busy_seconds.inc(dt)
         emitted = 0
         for slot in active:
+            if slot in skip:
+                continue
             acc = int(accepted[slot])
             if slot in proposed:  # acceptance rate over REAL proposals
                 # clamp both sides to the slot's true draft count: the verify
@@ -1522,21 +1687,30 @@ class Engine:
                 return
             active = self._active_slots()
         # Speculative path: only when nothing is waiting (prefill priority
-        # stands) and single-device (accept lengths are data-dependent per
-        # slot; a dp mesh would desync). Falls back when no context matched.
-        if (self.serving.spec_decode and self.mesh is None and horizon > 1
-                and not self._want_logprobs(self.slot_req)
-                and not (self.counts is not None
-                         and (self.pres_pens.any() or self.freq_pens.any()))
-                # spec verify has no stop-suppression masking: fall back to
-                # plain decode while any slot's min_tokens ban is active
-                and not (self.ban_until > self.lengths).any()
+        # stands) and the mesh is spec-safe (None or pure-tp — see
+        # _spec_mesh_ok). Eligibility is PER SLOT: a logprobs, penalized, or
+        # min_tokens-banned request is skipped by the verify dispatch (those
+        # features live only in the plain path) WITHOUT disabling speculation
+        # for its neighbors; the skipped slots advance on the alternating
+        # plain step (_spec_plain_due), so one logprobs request costs the
+        # batch one interleaved plain dispatch, not the whole spec win
+        # (VERDICT r3 weak #4: the old global .any() gates gave a single
+        # request a batch-wide blast radius). Falls back when no context
+        # matched.
+        if (self.serving.spec_decode and self._spec_mesh_ok and horizon > 1
+                and not self._spec_plain_due
+                # the verify dispatch writes spec_k + 1 rows for EVERY slot,
+                # so the bound stays global over the active set
                 and self.lengths[active].max(initial=0) + self.serving.spec_k
                 + 1 < self.max_len):
-            proposal = self._propose_drafts(active)
+            skip = {s for s in active if self._slot_spec_ineligible(s)}
+            proposal = self._propose_drafts([s for s in active
+                                             if s not in skip])
             if proposal is not None:
-                self._do_spec_decode(active, *proposal)
+                self._do_spec_decode(active, *proposal, skip=skip)
+                self._spec_plain_due = bool(skip)
                 return
+        self._spec_plain_due = False
         want_lp = self._want_logprobs(self.slot_req)
         want_pen = self.counts is not None and bool(
             self.pres_pens.any() or self.freq_pens.any())
@@ -1555,7 +1729,9 @@ class Engine:
             table=jnp.asarray(self.table) if self.paged else None,
             seeds=jnp.asarray(self.seeds),
             ban_ids=jnp.asarray(self.ban_ids),
-            ban_until=jnp.asarray(self.ban_until))
+            ban_until=jnp.asarray(self.ban_until),
+            bias_ids=jnp.asarray(self.bias_ids),
+            bias_vals=jnp.asarray(self.bias_vals))
         # un-penalized dispatches return a dummy counts array — keep ours
         self.counts = new_counts if want_pen else real_counts
         lp_t = None
@@ -1630,6 +1806,9 @@ class Engine:
         self.pres_pens[slot] = 0.0
         self.freq_pens[slot] = 0.0
         self.ban_until[slot] = 0
+        self.bias_ids[slot, :] = 2**31 - 1
+        self.bias_vals[slot, :] = 0.0
+        self._bias_n[slot] = 0
         self._release_slot_pages(slot)
         self.sched.release(slot)
         self.metrics.active_requests.set(len(self._active_slots()))
@@ -1762,7 +1941,9 @@ class Engine:
                     table=jnp.asarray(self.table) if self.paged else None,
                     seeds=jnp.asarray(self.seeds),
                     ban_ids=jnp.asarray(self.ban_ids),
-                    ban_until=jnp.asarray(self.ban_until))
+                    ban_until=jnp.asarray(self.ban_until),
+                    bias_ids=jnp.asarray(self.bias_ids),
+                    bias_vals=jnp.asarray(self.bias_vals))
             return
 
         # Distinct token values per warmup request — identical prompts would
@@ -1809,7 +1990,7 @@ class Engine:
             drain()
         # Speculative-verify program: a self-repeating prompt guarantees the
         # prompt-lookup proposer fires, compiling spec_decode_step.
-        if self.serving.spec_decode and self.mesh is None:
+        if self.serving.spec_decode and self._spec_mesh_ok:
             n = self.serving.spec_ngram
             pat = [11, 12, 13][:max(1, min(3, n))]
             r = Request(prompt_ids=(pat * (2 + (2 * n) // len(pat)))[:self.prompt_limit],
@@ -1845,7 +2026,9 @@ class Engine:
             table=jnp.asarray(self.table) if self.paged else None,
             seeds=jnp.asarray(self.seeds),
             ban_ids=jnp.asarray(self.ban_ids),
-            ban_until=jnp.asarray(self.ban_until))
+            ban_until=jnp.asarray(self.ban_until),
+            bias_ids=jnp.asarray(self.bias_ids),
+            bias_vals=jnp.asarray(self.bias_vals))
         del cnts
         # Logprobs program variants ('logprobs' is a static arg on every step
         # fn — distinct programs): one isolated request compiles the
@@ -1877,4 +2060,6 @@ class Engine:
             table=jnp.asarray(self.table) if self.paged else None,
             seeds=jnp.asarray(self.seeds),
             ban_ids=jnp.asarray(self.ban_ids),
-            ban_until=jnp.asarray(self.ban_until))
+            ban_until=jnp.asarray(self.ban_until),
+            bias_ids=jnp.asarray(self.bias_ids),
+            bias_vals=jnp.asarray(self.bias_vals))

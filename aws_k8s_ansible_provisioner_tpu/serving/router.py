@@ -8,22 +8,35 @@ The contract preserved:
   test playbook's ephemeral curl pods work against the router exactly as they
   did against the llm-d gateway;
 - load-balances across every replica behind the backend Service by resolving
-  the DNS name to all A records per request batch (headless-Service friendly)
-  and round-robining over them — the "latent DP" the reference hinted at with
-  its two model PVCs (SURVEY.md §2.3);
+  the DNS name to all A records (headless-Service friendly) — or a static
+  comma-separated ``host:port`` list — the "latent DP" the reference hinted
+  at with its two model PVCs (SURVEY.md §2.3);
+- routes INFERENCE-AWARE, the actual capability of the llm-d gateway it
+  replaces (VERDICT r3 missing #4: round-robin in front of
+  continuous-batching engines with prefix caches throws away both signals):
+  a ~1 Hz poller reads each replica's 3-field ``/load`` endpoint and requests
+  go to the least-loaded replica; completion requests carry a prompt-prefix
+  affinity key, and same-prefix requests stick to the same replica while its
+  load permits — which is what makes the engines' paged prefix caches
+  (hash-chain page sharing) actually hit across requests;
 - retries idempotent-safe failures on the next replica, taking a dead backend
   out of rotation for a cooldown window (the health-driven routing the
   reference delegated to the external gateway);
 - streams responses through unbuffered (SSE passthrough for
   ``stream: true`` completions).
 
-Stdlib-only (http.server + urllib) so the router container needs nothing
-beyond the framework image.
+Affinity keys hash the leading PROMPT TEXT (the router deliberately carries
+no tokenizer): tokenization is prefix-stable for equal text, so equal text
+prefixes are exactly the requests whose token pages the engine's hash-chain
+index can share. Stdlib-only (http.server + urllib) so the router container
+needs nothing beyond the framework image.
 """
 
 from __future__ import annotations
 
 import argparse
+import collections
+import hashlib
 import http.client
 import itertools
 import json
@@ -68,35 +81,109 @@ class RouterMetrics:
             "tpu_router_backends", "Currently resolved backend replicas"))
 
 
+# A /load sample older than this no longer orders candidates (a replica that
+# stopped answering its poller is either dead — the connect path will find
+# out — or wedged; either way its last-known load is fiction).
+LOAD_TTL_S = 5.0
+# Affinity yields when the sticky replica's in-flight+queued exceeds the
+# least-loaded replica's by more than this (prefix reuse saves prefill; it
+# never justifies queueing behind a pile while a sibling idles).
+LOAD_SLACK = 4
+AFFINITY_CAP = 8192           # LRU entries (prefix-key -> replica)
+AFFINITY_PREFIX_CHARS = 512   # prompt chars hashed into the key
+
+
 class BackendPool:
-    """Round-robin pool over the backend service's resolved replicas."""
+    """Replica pool: least-loaded-first with prefix affinity, round-robin
+    fallback while load is unknown.
+
+    Backends come from DNS (``host:port`` resolved to all A records — the
+    headless-Service contract) or a static comma-separated ``host:port``
+    list (in-process rehearsal + mixed-port layouts). Internal addresses are
+    ``"host:port"`` strings either way.
+    """
 
     def __init__(self, backend_service: str, refresh_s: float = 10.0,
-                 cooldown_s: float = 15.0):
-        host, sep, port = backend_service.rpartition(":")
-        if not sep or not host or not port.isdigit():
-            raise ValueError(
-                f"--backend-service must be host:port, got {backend_service!r}")
-        self.host = host
-        self.port = int(port)
+                 cooldown_s: float = 15.0, load_slack: int = LOAD_SLACK):
+        self._static: list[str] = []
+        self.host = self.port = None
+        if "," in backend_service:
+            for part in backend_service.split(","):
+                host, sep, port = part.strip().rpartition(":")
+                if not sep or not host or not port.isdigit():
+                    raise ValueError(f"--backend-service list entries must "
+                                     f"be host:port, got {part!r}")
+                self._static.append(f"{host}:{port}")
+        else:
+            host, sep, port = backend_service.rpartition(":")
+            if not sep or not host or not port.isdigit():
+                raise ValueError(f"--backend-service must be host:port, "
+                                 f"got {backend_service!r}")
+            self.host = host
+            self.port = int(port)
         self.refresh_s = refresh_s
         self.cooldown_s = cooldown_s
+        self.load_slack = load_slack
         self._lock = threading.Lock()
-        self._addrs: list[str] = []
+        self._addrs: list[str] = list(self._static)
         self._rr = itertools.count()
         self._dead: dict[str, float] = {}
         self._last_refresh = 0.0
+        # addr -> (active + queued, t_sampled); written by the ~1 Hz poller
+        self._load: dict[str, tuple[int, float]] = {}
+        # prompt-prefix key -> last replica that served it (LRU)
+        self._affinity: "collections.OrderedDict[str, str]" = \
+            collections.OrderedDict()
 
     def _resolve(self) -> list[str]:
+        if self._static:
+            return list(self._static)
         try:
             infos = socket.getaddrinfo(self.host, self.port, socket.AF_INET,
                                        socket.SOCK_STREAM)
-            return sorted({i[4][0] for i in infos})
+            return sorted({f"{i[4][0]}:{self.port}" for i in infos})
         except socket.gaierror:
             return []
 
-    def pick(self) -> list[str]:
-        """Return candidate backends, healthiest-first (round-robin rotation)."""
+    def addrs(self) -> list[str]:
+        """Current replica set (refreshing if stale) — the poller's target
+        list."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_refresh > self.refresh_s or not self._addrs:
+                addrs = self._resolve()
+                if addrs:
+                    self._addrs = addrs
+                self._last_refresh = now
+            return list(self._addrs)
+
+    def note_load(self, addr: str, active: int, queued: int):
+        with self._lock:
+            self._load[addr] = (int(active) + int(queued), time.monotonic())
+
+    def note_affinity(self, key: str, addr: str):
+        """Remember which replica served this prompt prefix (its pages are
+        now in that replica's prefix index)."""
+        with self._lock:
+            self._affinity[key] = addr
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > AFFINITY_CAP:
+                self._affinity.popitem(last=False)
+
+    def _score(self, addr: str, now: float):
+        ent = self._load.get(addr)
+        if ent is None or now - ent[1] > LOAD_TTL_S:
+            return None
+        return ent[0]
+
+    def pick(self, affinity_key: str | None = None) -> list[str]:
+        """Candidate backends, best-first.
+
+        Ordering: (1) the affinity replica, while alive and within
+        ``load_slack`` of the least-loaded; (2) replicas with fresh /load
+        samples, least-loaded first; (3) load-unknown replicas in round-robin
+        rotation (the whole pool degrades to plain round-robin when the
+        poller hasn't run — cold start, tests, or a /load-less backend)."""
         now = time.monotonic()
         with self._lock:
             if now - self._last_refresh > self.refresh_s or not self._addrs:
@@ -111,14 +198,95 @@ class BackendPool:
             if not pool:
                 return []
             k = next(self._rr) % len(pool)
-            return pool[k:] + pool[:k]
+            rotated = pool[k:] + pool[:k]
+            scored = [(self._score(a, now), a) for a in rotated]
+            known = [(s, a) for s, a in scored if s is not None]
+            unknown = [a for s, a in scored if s is None]
+            known.sort(key=lambda sa: sa[0])
+            order = [a for _, a in known] + unknown
+            if affinity_key is not None:
+                sticky = self._affinity.get(affinity_key)
+                if sticky in pool and sticky != order[0]:
+                    s = self._score(sticky, now)
+                    best = known[0][0] if known else None
+                    if s is None or best is None \
+                            or s <= best + self.load_slack:
+                        order.remove(sticky)
+                        order.insert(0, sticky)
+            return order
 
     def mark_dead(self, addr: str):
         with self._lock:
             self._dead[addr] = time.monotonic()
+            self._load.pop(addr, None)
 
     def url(self, addr: str, path: str) -> str:
-        return f"http://{addr}:{self.port}{path}"
+        return f"http://{addr}{path}"
+
+
+def _affinity_key(path: str, body: bytes | None) -> str | None:
+    """Prefix-affinity key for a completion POST: hash of the leading prompt
+    text (chat: the serialized messages). None = no affinity (malformed or
+    non-completion traffic routes purely by load)."""
+    if not body:
+        return None
+    try:
+        obj = json.loads(body)
+        if path.startswith("/v1/chat/completions"):
+            text = json.dumps(obj.get("messages", ""), sort_keys=True)
+        else:
+            prompt = obj.get("prompt", "")
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ""
+            text = prompt if isinstance(prompt, str) else ""
+        if not text:
+            return None
+        return hashlib.sha1(
+            text[:AFFINITY_PREFIX_CHARS].encode("utf-8", "replace")
+        ).hexdigest()
+    except (ValueError, TypeError, AttributeError):
+        return None
+
+
+def start_load_poller(pool: BackendPool, interval_s: float = 1.0,
+                      stop: threading.Event | None = None) -> threading.Thread:
+    """~1 Hz /load poller feeding BackendPool.note_load. A replica that
+    fails the poll just loses its (stale-TTL'd) sample — the request path's
+    connect failures own dead-marking."""
+
+    def poll_once():
+        for addr in pool.addrs():
+            host, _, port = addr.rpartition(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=2.0)
+            try:
+                conn.request("GET", "/load")
+                resp = conn.getresponse()
+                if resp.status == 200:
+                    d = json.loads(resp.read())
+                    if isinstance(d, dict):
+                        pool.note_load(addr, d.get("active", 0) or 0,
+                                       d.get("queued", 0) or 0)
+            except Exception:
+                # NEVER let a malformed reply kill the poller thread — the
+                # router would silently degrade to round-robin for its whole
+                # lifetime (review r4). A failed poll just leaves the
+                # replica's sample to the stale-TTL.
+                log.debug("load poll of %s failed", addr, exc_info=True)
+            finally:
+                conn.close()
+
+    def run():
+        while stop is None or not stop.is_set():
+            poll_once()
+            if stop is not None and stop.wait(interval_s):
+                break
+            if stop is None:
+                time.sleep(interval_s)
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="router-load-poller")
+    t.start()
+    return t
 
 
 class RouterHandler(BaseHTTPRequestHandler):
@@ -155,7 +323,12 @@ class RouterHandler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else None
-        candidates = self.pool.pick()
+        path = self.path.split("?")[0]
+        affinity_key = None
+        if method == "POST" and path in ("/v1/completions",
+                                         "/v1/chat/completions"):
+            affinity_key = _affinity_key(path, body)
+        candidates = self.pool.pick(affinity_key)
         self.metrics.backends.set(len(self.pool._addrs))
         if not candidates:
             self.metrics.requests.inc(code="503")
@@ -175,7 +348,8 @@ class RouterHandler(BaseHTTPRequestHandler):
             # non-idempotent POST cannot have started generating (ADVICE r1:
             # retrying POSTs after a long read timeout duplicated in-flight
             # generations).
-            conn = http.client.HTTPConnection(addr, self.pool.port,
+            a_host, _, a_port = addr.rpartition(":")
+            conn = http.client.HTTPConnection(a_host, int(a_port),
                                               timeout=CONNECT_TIMEOUT_S)
             try:
                 conn.connect()
@@ -216,6 +390,9 @@ class RouterHandler(BaseHTTPRequestHandler):
             # while relaying must NOT retry another replica (that would splice
             # a second status line into the body) and a client disconnect
             # (BrokenPipeError) must NOT mark the backend dead.
+            if affinity_key is not None and resp.status < 500:
+                # this replica now holds the prefix's pages — stick to it
+                self.pool.note_affinity(affinity_key, addr)
             try:
                 self.metrics.requests.inc(code=str(resp.status))
                 self.send_response(resp.status)
@@ -267,6 +444,7 @@ class RouterHandler(BaseHTTPRequestHandler):
 def serve(backend_service: str, host: str, port: int):
     RouterHandler.pool = BackendPool(backend_service)
     RouterHandler.metrics = RouterMetrics()
+    start_load_poller(RouterHandler.pool)
     httpd = ThreadingHTTPServer((host, port), RouterHandler)
     log.info("router listening on %s:%d -> %s", host, port, backend_service)
     httpd.serve_forever()

@@ -183,6 +183,15 @@ class Handler(BaseHTTPRequestHandler):
                 "stalled_for_s": round(stalled, 1) or None,
                 "last_error": eng.last_error or None,
             })
+        elif path == "/load":
+            # Tiny load snapshot for the gateway's ~1 Hz poller (router.py
+            # load-aware routing — VERDICT r3 next #5): kept separate from
+            # /health (which runs stall diagnostics) and /metrics (whose
+            # render cost scales with series count).
+            eng = self.state.engine
+            self._json(200, {"active": len(eng._active_slots()),
+                             "queued": len(eng.pending),
+                             "slots": eng.num_slots})
         elif path == "/debug/profile":
             self._profile()
         else:
@@ -376,6 +385,36 @@ class Handler(BaseHTTPRequestHandler):
         if stream and lp_n is not None:
             return self._error(400, "logprobs with stream=true is not "
                                     "supported")
+        # OpenAI ``logit_bias``: {token_id: bias} map, additive on logits
+        # before every sampling decision (±100 act as force/ban). vLLM
+        # behind the reference's gateway accepts it; BIAS_K caps entries.
+        from aws_k8s_ansible_provisioner_tpu.serving.engine import BIAS_K
+        raw_bias = body.get("logit_bias") or {}
+        if not isinstance(raw_bias, dict):
+            return self._error(400, "'logit_bias' must be an object mapping "
+                                    "token ids to bias values")
+        try:
+            logit_bias = tuple(sorted((int(k), float(v))
+                                      for k, v in raw_bias.items()))
+        except (TypeError, ValueError):
+            return self._error(400, "'logit_bias' keys must be token ids "
+                                    "and values numbers")
+        if len(logit_bias) > BIAS_K:
+            return self._error(400, f"'logit_bias' supports at most "
+                                    f"{BIAS_K} entries")
+        if any(t < 0 for t, _ in logit_bias):
+            return self._error(400, "'logit_bias' token ids must be >= 0")
+        if any(not (-100.0 <= v <= 100.0) for _, v in logit_bias):
+            return self._error(400, "'logit_bias' values must be in "
+                                    "[-100, 100]")
+        # OpenAI ``stream_options``: include_usage adds a final usage-only
+        # chunk to the SSE stream (and a null usage field on every chunk).
+        so = body.get("stream_options") or {}
+        if not isinstance(so, dict):
+            return self._error(400, "'stream_options' must be an object")
+        if so and not stream:
+            return self._error(400, "'stream_options' requires stream=true")
+        include_usage = bool(so.get("include_usage", False))
 
         prompt_ids = st.tokenizer.encode(prompt_text)
         if not prompt_ids:
@@ -397,6 +436,7 @@ class Handler(BaseHTTPRequestHandler):
                 presence_penalty=presence_penalty,
                 frequency_penalty=frequency_penalty,
                 stop_token_ids=stop_token_ids, min_tokens=min_tokens,
+                logit_bias=logit_bias,
                 seed=None if seed is None else seed + i)
                 for i in range(best_of)]
         except ContextLengthExceeded as e:
@@ -411,7 +451,9 @@ class Handler(BaseHTTPRequestHandler):
 
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         if stream:
-            self._stream_response(reqs[0], rid, chat, stops)
+            self._stream_response(reqs[0], rid, chat, stops,
+                                  n_prompt=len(prompt_ids),
+                                  include_usage=include_usage)
         else:
             self._full_response(reqs, rid, chat, stops, len(prompt_ids),
                                 n_choices=n_choices,
@@ -488,7 +530,8 @@ class Handler(BaseHTTPRequestHandler):
                          "created": _now(), "model": st.model_name,
                          "choices": choices, "usage": usage})
 
-    def _stream_response(self, req, rid: str, chat: bool, stops: List[str]):
+    def _stream_response(self, req, rid: str, chat: bool, stops: List[str],
+                         n_prompt: int = 0, include_usage: bool = False):
         """SSE streaming with incremental detokenization.
 
         Correctness over eagerness: text is held back while it could still be
@@ -525,7 +568,14 @@ class Handler(BaseHTTPRequestHandler):
                 payload["delta"] = d
             else:
                 payload["text"] = delta_text or ""
-            raw_write(f"data: {json.dumps({'id': rid, 'object': obj, 'created': _now(), 'model': st.model_name, 'choices': [payload]})}\n\n".encode())
+            body = {"id": rid, "object": obj, "created": _now(),
+                    "model": st.model_name, "choices": [payload]}
+            if include_usage:
+                # OpenAI stream_options.include_usage: every content chunk
+                # carries usage: null; the final stats ride a dedicated
+                # choices-less chunk before [DONE]
+                body["usage"] = None
+            raw_write(f"data: {json.dumps(body)}\n\n".encode())
 
         detok = IncrementalDetokenizer(st.tokenizer)
         hold = max((len(s) for s in stops if s), default=1) - 1
@@ -551,6 +601,15 @@ class Handler(BaseHTTPRequestHandler):
                     chunk(ready, None)
                     pending = pending[len(ready):]
             chunk(None, finish)
+            if include_usage:
+                n_gen = len(req.generated)
+                raw_write(("data: " + json.dumps({
+                    "id": rid, "object": obj, "created": _now(),
+                    "model": st.model_name, "choices": [],
+                    "usage": {"prompt_tokens": n_prompt,
+                              "completion_tokens": n_gen,
+                              "total_tokens": n_prompt + n_gen},
+                }) + "\n\n").encode())
             raw_write(b"data: [DONE]\n\n")
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
@@ -678,6 +737,11 @@ def serve(state: ServerState, host: str, port: int,
         ready_event.set()
         stop.wait()
         httpd.shutdown()
+        # Close the LISTENING socket too: shutdown() only stops the accept
+        # loop, leaving connects to land in the kernel backlog and black-hole
+        # — a stopped replica must refuse connections so a gateway's
+        # connect-phase failover (router.py) sees it dead immediately.
+        httpd.server_close()
     else:
         try:
             httpd.serve_forever()

@@ -81,12 +81,14 @@ def test_mesh_engine_pallas_interpret_parity(setup):
 
 
 def test_mesh_cache_is_actually_sharded(setup):
-    """The KV cache must be allocated sharded (slots over dp, kv heads over
-    tp): each device holds 1/(dp*tp) of it — ADVICE r1: allocating unsharded
-    then resharding would OOM one chip at init."""
+    """The KV cache must be allocated sharded: each device holds 1/(dp*tp)
+    of it — ADVICE r1: allocating unsharded then resharding would OOM one
+    chip at init. Dense layout: slots over dp, kv heads over tp. Paged
+    layout: pool pages over dp, kv heads over tp."""
     cfg, params, serving = setup
     mesh = _mesh(2, 2)
-    engine = Engine(cfg, params, serving, mesh=mesh)
+    dense = dataclasses.replace(serving, paged=False)
+    engine = Engine(cfg, params, dense, mesh=mesh)
     k = engine.cache["k"]  # [L, slots, Hkv, S, D]
     sharding = k.sharding
     assert isinstance(sharding, jax.sharding.NamedSharding)
@@ -95,6 +97,15 @@ def test_mesh_cache_is_actually_sharded(setup):
     shard_shape = k.addressable_shards[0].data.shape
     assert shard_shape[1] == serving.max_decode_slots // 2   # slots / dp
     assert shard_shape[2] == cfg.num_kv_heads // 2           # heads / tp
+
+    paged = Engine(cfg, params, serving, mesh=mesh)
+    assert paged.paged
+    pk = paged.cache["k"]  # [L, pages, Hkv, page, D]
+    assert pk.sharding.spec == jax.sharding.PartitionSpec(
+        None, "dp", "tp", None, None)
+    pshard = pk.addressable_shards[0].data.shape
+    assert pshard[1] == paged._group_pages                   # pages / dp
+    assert pshard[2] == cfg.num_kv_heads // 2                # heads / tp
 
 
 def test_mesh_dp_divisibility_error(setup):
@@ -213,14 +224,14 @@ def test_mesh_sp1_allows_unaligned_cache(setup):
 def test_tp_mesh_keeps_paged_cache(setup):
     """tp shards only the pool's head axis, so paging (page-gated admission,
     on-demand growth) must survive under a tp mesh — the Qwen3-8B/v5e-8
-    flagship config; dp/sp meshes fall back to the dense layout."""
+    flagship config; sp meshes fall back to the dense layout."""
     cfg, params, serving = setup
     tp_eng = Engine(cfg, params, serving, mesh=_mesh(1, 2))
     assert tp_eng.paged and tp_eng.cache["k"].ndim == 5
     assert tp_eng.cache["k"].shape[1] == \
         serving.max_decode_slots * (tp_eng.max_len // serving.page_size) + 1
-    dp_eng = Engine(cfg, params, serving, mesh=_mesh(2, 1))
-    assert not dp_eng.paged
+    sp_eng = Engine(cfg, params, serving, mesh=_mesh3(1, 1, 2))
+    assert not sp_eng.paged
 
     # page-gated admission works under the tp mesh: a pool of one window
     # serializes two prompts over 4 free slots
@@ -238,3 +249,67 @@ def test_tp_mesh_keeps_paged_cache(setup):
         if not eng.step():
             break
     assert len(a.generated) == 2 and len(b.generated) == 2
+
+
+# ---------------------------------------------------------------------------
+# Paged KV under dp meshes: per-group pool partitions (VERDICT r3 next #6)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl", ["auto", "pallas"])
+def test_dp_mesh_keeps_paged_cache_with_token_parity(setup, impl):
+    """dp shards the pool's PAGE axis into per-group partitions with
+    per-group host allocators — multi-replica-per-host dp serving must keep
+    on-demand paging (the r3 fallback to dense re-imported the capacity
+    ceiling paging removes) AND hold greedy token parity with the
+    single-device paged engine."""
+    cfg, params, serving = setup
+    serving_i = dataclasses.replace(serving, attention_impl=impl)
+    rng = np.random.default_rng(21)
+    prompts = [rng.integers(2, cfg.vocab_size, n).tolist()
+               for n in (3, 7, 12, 5)]
+
+    single = Engine(cfg, params, serving_i)
+    assert single.paged
+    expected = _run_all(single, prompts)
+
+    dp_eng = Engine(cfg, params, serving_i, mesh=_mesh(2, 1))
+    assert dp_eng.paged, "dp mesh must keep the paged pool"
+    assert dp_eng.dp_groups == 2
+    # pool page axis = dp * (group_pages + 1), sharded over dp
+    group_pages = (serving.max_decode_slots
+                   * (dp_eng.max_len // serving.page_size)) // 2
+    assert dp_eng.cache["k"].shape[1] == 2 * (group_pages + 1)
+    assert _run_all(dp_eng, prompts) == expected
+
+    dptp_eng = Engine(cfg, params, serving_i, mesh=_mesh(2, 2))
+    assert dptp_eng.paged
+    assert _run_all(dptp_eng, prompts) == expected
+
+
+def test_dp_paged_admission_and_preemption_are_group_local(setup):
+    """A tiny per-group pool under dp=2: admission gates on the best group's
+    headroom, preemption victims come from the starving slot's OWN group
+    (another group's pages are unreachable), and every request still
+    completes with the right token count."""
+    cfg, params, serving = setup
+    small = dataclasses.replace(serving, kv_pool_pages=8, page_size=8,
+                                max_cache_len=32, prefill_buckets=(8, 16, 32))
+    eng = Engine(cfg, params, small, mesh=_mesh(2, 1))
+    assert eng.paged and eng.dp_groups == 2
+    # per-group partition: 8 // 2 = 4 pages + scratch
+    assert eng._group_pages == 5
+    reqs = [eng.submit(Request(prompt_ids=[5 + i] * 17, max_tokens=4,
+                               ignore_eos=True)) for i in range(4)]
+    for _ in range(10000):
+        if not eng.step():
+            break
+    assert all(len(r.generated) == 4 for r in reqs)
+    # and parity with the single-device engine under the same tiny pool
+    single = Engine(cfg, params, dataclasses.replace(small, kv_pool_pages=4))
+    ref = [single.submit(Request(prompt_ids=[5 + i] * 17, max_tokens=4,
+                                 ignore_eos=True)) for i in range(4)]
+    for _ in range(10000):
+        if not single.step():
+            break
+    assert [r.generated for r in reqs] == [r.generated for r in ref]

@@ -67,6 +67,31 @@ def test_production_render_unchanged_by_gating():
     assert "google.com/tpu" in c["resources"]["limits"]
 
 
+def test_rehearsal_render_multi_replica():
+    """serving_replicas=2 — the router→N-backends topology llm-d actually
+    exercises (VERDICT r3 next #4): the engine Deployment scales, the
+    headless Service still fronts it, and the router deployment points at
+    that Service so its DNS resolution sees BOTH replica pod IPs."""
+    docs = _render(rehearsal_cpu=True, model="tiny-qwen3",
+                   framework_image="img", storage_class="standard",
+                   serving_replicas=2)
+    eng = next(d for d in docs if d["kind"] == "Deployment"
+               and d["metadata"]["name"] == "tpu-serving-engine")
+    assert eng["spec"]["replicas"] == 2
+    svcs = [d for d in docs if d["kind"] == "Service"]
+    eng_svc = next(s for s in svcs
+                   if s["spec"].get("selector", {}).get("app") ==
+                   eng["spec"]["selector"]["matchLabels"]["app"])
+    # headless: DNS returns every replica's pod IP — what BackendPool
+    # resolves and round-robins/load-ranks over
+    assert eng_svc["spec"].get("clusterIP") == "None"
+    router = next(d for d in docs if d["kind"] == "Deployment"
+                  and "gateway" in d["metadata"]["name"])
+    rc = router["spec"]["template"]["spec"]["containers"][0]
+    joined = " ".join(rc["command"])
+    assert eng_svc["metadata"]["name"] in joined
+
+
 def test_rehearsal_script_bash_clean():
     subprocess.run(["bash", "-n", str(REPO / "deploy" / "rehearse-kind.sh")],
                    check=True)

@@ -88,7 +88,7 @@ def test_capacity_scales_with_actual_lengths(model):
     for i, r in enumerate(reqs):
         assert len(r.generated) == 6
         assert r.generated == _greedy_reference(model, [i + 2] * 5, 6)
-    st = eng.allocator.stats()
+    st = eng.allocators[0].stats()
     assert st["pages_live"] == 0       # everything released at finish
 
 
@@ -176,7 +176,7 @@ def test_preempted_resume_hits_its_own_pages(model):
 def test_dense_mode_unaffected(model):
     """paged=False keeps the slot-contiguous layout end to end."""
     eng = _engine(model, paged=False)
-    assert not eng.paged and not hasattr(eng, "allocator")
+    assert not eng.paged and not hasattr(eng, "allocators")
     r = eng.submit(Request(prompt_ids=[7] * 5, max_tokens=4, ignore_eos=True))
     _drain(eng)
     assert len(r.generated) == 4

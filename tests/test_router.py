@@ -138,9 +138,10 @@ def test_router_failover_on_connect_refused(backend):
         def __init__(self):
             super().__init__(f"127.0.0.1:{backend.server_port}")
 
-        def pick(self):
+        def pick(self, affinity_key=None):
             # first candidate: a loopback address with no listener -> refused
-            return ["127.255.255.254", "127.0.0.1"]
+            return ["127.255.255.254:9",
+                    f"127.0.0.1:{backend.server_port}"]
 
     old, oldm = RouterHandler.pool, RouterHandler.metrics
     RouterHandler.pool = DeadFirstPool()
@@ -178,3 +179,179 @@ def test_pool_rejects_malformed_backend_service():
     for bad in ("no-port-here", "host:", ":8000", "host:notaport"):
         with pytest.raises(ValueError):
             BackendPool(bad)
+
+
+# ---------------------------------------------------------------------------
+# Load-aware + prefix-affine routing (VERDICT r3 next #5): the actual
+# capability of the llm-d inference gateway the router replaces
+# (/root/reference/llm-d-deploy.yaml:176-193 deploys it precisely for
+# inference-aware endpoint picking).
+# ---------------------------------------------------------------------------
+
+
+def _frozen_pool(addrs, **kw):
+    pool = BackendPool("127.0.0.1:1", **kw)
+    pool._addrs = list(addrs)
+    pool._last_refresh = float("inf")
+    return pool
+
+
+def test_pick_prefers_least_loaded():
+    """Fresh /load samples order candidates least-loaded-first, and the
+    ordering CONVERGES (every pick agrees) instead of alternating."""
+    pool = _frozen_pool(["a:1", "b:1", "c:1"])
+    pool.note_load("a:1", active=3, queued=5)
+    pool.note_load("b:1", active=0, queued=0)
+    pool.note_load("c:1", active=2, queued=0)
+    for _ in range(6):
+        assert pool.pick() == ["b:1", "c:1", "a:1"]
+
+
+def test_pick_falls_back_to_round_robin_without_load():
+    """No poller samples (cold start / load-less backend) → plain rotation,
+    the pre-r4 behavior."""
+    pool = _frozen_pool(["a:1", "b:1"])
+    firsts = {pool.pick()[0] for _ in range(4)}
+    assert firsts == {"a:1", "b:1"}
+
+
+def test_stale_load_sample_ignored(monkeypatch):
+    import aws_k8s_ansible_provisioner_tpu.serving.router as rt
+
+    pool = _frozen_pool(["a:1", "b:1"])
+    pool.note_load("a:1", active=9, queued=9)
+    # age the sample past the TTL
+    pool._load["a:1"] = (18, __import__("time").monotonic() - rt.LOAD_TTL_S - 1)
+    firsts = {pool.pick()[0] for _ in range(4)}
+    assert firsts == {"a:1", "b:1"}   # stale sample no longer orders
+
+
+def test_affinity_sticks_while_load_permits():
+    pool = _frozen_pool(["a:1", "b:1"])
+    pool.note_load("a:1", active=2, queued=0)
+    pool.note_load("b:1", active=0, queued=0)
+    pool.note_affinity("k1", "a:1")
+    # within slack (2 <= 0 + 4): sticky replica first despite higher load
+    for _ in range(3):
+        assert pool.pick("k1")[0] == "a:1"
+    # no affinity key → least-loaded first
+    assert pool.pick()[0] == "b:1"
+
+
+def test_affinity_yields_when_overloaded():
+    pool = _frozen_pool(["a:1", "b:1"], load_slack=4)
+    pool.note_affinity("k1", "a:1")
+    pool.note_load("a:1", active=8, queued=3)   # 11 > 0 + slack(4)
+    pool.note_load("b:1", active=0, queued=0)
+    assert pool.pick("k1")[0] == "b:1"
+
+
+def test_affinity_key_from_bodies():
+    from aws_k8s_ansible_provisioner_tpu.serving.router import _affinity_key
+
+    k1 = _affinity_key("/v1/completions", json.dumps(
+        {"prompt": "shared prefix " * 40 + "tail A"}).encode())
+    k2 = _affinity_key("/v1/completions", json.dumps(
+        {"prompt": "shared prefix " * 40 + "tail B"}).encode())
+    assert k1 and k1 == k2   # same 512-char prefix → same key
+    k3 = _affinity_key("/v1/completions",
+                       json.dumps({"prompt": "different"}).encode())
+    assert k3 and k3 != k1
+    kc = _affinity_key("/v1/chat/completions", json.dumps(
+        {"messages": [{"role": "user", "content": "hi"}]}).encode())
+    assert kc
+    assert _affinity_key("/v1/completions", b"not json") is None
+    assert _affinity_key("/v1/completions", None) is None
+
+
+class LoadReportingEngine(FakeEngine):
+    """Fake backend that reports a fixed /load and echoes its port."""
+
+    def do_GET(self):
+        if self.path == "/load":
+            self._send(200, {"active": self.server.fake_active,
+                             "queued": 0, "slots": 4})
+        else:
+            FakeEngine.do_GET(self)
+
+
+def test_poller_feeds_pool_and_requests_converge():
+    """End-to-end load-aware path: two fake backends with unequal /load, the
+    real poller samples them, and completion POSTs (distinct prompts, so no
+    affinity stickiness) all land on the less-loaded replica."""
+    import time as _t
+
+    from aws_k8s_ansible_provisioner_tpu.serving.router import (
+        start_load_poller)
+
+    srvs = []
+    for active in (5, 0):
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), LoadReportingEngine)
+        srv.fake_active = active
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        srvs.append(srv)
+    addrs = [f"127.0.0.1:{s.server_port}" for s in srvs]
+    pool = BackendPool(",".join(addrs))
+    stop = threading.Event()
+    start_load_poller(pool, interval_s=0.1, stop=stop)
+    old, oldm = RouterHandler.pool, RouterHandler.metrics
+    RouterHandler.pool = pool
+    RouterHandler.metrics = RouterMetrics()
+    router = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    try:
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline and len(pool._load) < 2:
+            _t.sleep(0.05)
+        assert len(pool._load) == 2, "poller never sampled both backends"
+        ports = []
+        for i in range(4):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.server_port}/v1/completions",
+                data=json.dumps({"prompt": f"unique {i}"}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                ports.append(json.loads(r.read())["port"])
+        assert all(p == srvs[1].server_port for p in ports), \
+            f"requests did not converge on the idle replica: {ports}"
+    finally:
+        stop.set()
+        router.shutdown()
+        for s in srvs:
+            s.shutdown()
+        RouterHandler.pool, RouterHandler.metrics = old, oldm
+
+
+def test_same_prefix_requests_stick_to_one_backend():
+    """Prefix affinity through the real handler: same-prompt POSTs land on
+    the SAME replica (that replica's paged prefix index holds the pages), a
+    different prompt is free to go elsewhere."""
+    srvs = []
+    for _ in range(2):
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), FakeEngine)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        srvs.append(srv)
+    addrs = [f"127.0.0.1:{s.server_port}" for s in srvs]
+    old, oldm = RouterHandler.pool, RouterHandler.metrics
+    RouterHandler.pool = BackendPool(",".join(addrs))
+    RouterHandler.metrics = RouterMetrics()
+    router = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+
+    def post(prompt):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.server_port}/v1/completions",
+            data=json.dumps({"prompt": prompt}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())["port"]
+
+    try:
+        ports = [post("the shared conversation history") for _ in range(5)]
+        assert len(set(ports)) == 1, \
+            f"same-prefix requests scattered across replicas: {ports}"
+    finally:
+        router.shutdown()
+        for s in srvs:
+            s.shutdown()
+        RouterHandler.pool, RouterHandler.metrics = old, oldm

@@ -1,0 +1,216 @@
+"""Router→N-replicas end-to-end, in-process (VERDICT r3 next #4).
+
+The kind rehearsal cannot execute in this environment (no docker), so this
+drives the SAME path with real processes' worth of components in one test:
+two REAL engine servers (tiny model, CPU) behind the REAL router, running
+the full L4 sequence from the reference's test playbook
+(/root/reference/llm-d-test.yaml) through the gateway — the /v1/models
+assert (:54-59), a completion POST (:61-78), a STREAMED completion — then a
+backend death with cooldown + failover, and a mid-stream backend death that
+must truncate cleanly (never splice a second response into the body).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+from aws_k8s_ansible_provisioner_tpu.serving.router import (
+    BackendPool, RouterHandler, RouterMetrics, start_load_poller)
+from aws_k8s_ansible_provisioner_tpu.serving.server import build_state, serve
+from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+MODEL_NAME = "tiny-qwen3"
+BASE_PORT = 18230
+
+
+def _start_engine(port):
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    serving = ServingConfig(model=MODEL_NAME, max_decode_slots=4,
+                            max_cache_len=128, prefill_buckets=(16, 32, 64),
+                            dtype="float32")
+    state = build_state(serving, model_cfg=cfg, params=params, tokenizer=tok)
+    ready, stop = threading.Event(), threading.Event()
+    t = threading.Thread(target=serve,
+                         args=(state, "127.0.0.1", port, ready, stop),
+                         daemon=True)
+    t.start()
+    assert ready.wait(30)
+    return stop
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """Two real engine servers + the real router with its load poller."""
+    stops = [_start_engine(BASE_PORT), _start_engine(BASE_PORT + 1)]
+    addrs = f"127.0.0.1:{BASE_PORT},127.0.0.1:{BASE_PORT + 1}"
+    old, oldm = RouterHandler.pool, RouterHandler.metrics
+    RouterHandler.pool = BackendPool(addrs, cooldown_s=30.0)
+    RouterHandler.metrics = RouterMetrics()
+    poll_stop = threading.Event()
+    start_load_poller(RouterHandler.pool, interval_s=0.2, stop=poll_stop)
+    router = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    yield router, stops
+    poll_stop.set()
+    router.shutdown()
+    for s in stops:
+        s.set()
+    RouterHandler.pool, RouterHandler.metrics = old, oldm
+
+
+def _url(router, path):
+    return f"http://127.0.0.1:{router.server_port}{path}"
+
+
+def test_l4_sequence_through_router(stack):
+    """The reference's acceptance gate, through the multi-replica gateway:
+    models assert, completion POST, streamed completion."""
+    router, _ = stack
+    # 1. GET /v1/models (llm-d-test.yaml:32-48) + the :54-59 assert
+    with urllib.request.urlopen(_url(router, "/v1/models"), timeout=60) as r:
+        body = json.loads(r.read())
+    assert MODEL_NAME in json.dumps(body)
+    # 2. POST /v1/completions (llm-d-test.yaml:61-78)
+    req = urllib.request.Request(
+        _url(router, "/v1/completions"),
+        data=json.dumps({"model": MODEL_NAME, "prompt": "Who are you?",
+                         "max_tokens": 8}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        body = json.loads(r.read())
+    assert body["object"] == "text_completion"
+    assert body["choices"][0]["finish_reason"] in ("stop", "length")
+    # 3. streamed completion through the gateway (SSE passthrough)
+    req = urllib.request.Request(
+        _url(router, "/v1/completions"),
+        data=json.dumps({"model": MODEL_NAME, "prompt": "abc",
+                         "max_tokens": 5, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = r.read().decode()
+    events = [ln for ln in raw.splitlines() if ln.startswith("data: ")]
+    assert events[-1] == "data: [DONE]"
+
+
+def test_backend_death_cooldown_and_failover(stack):
+    """Kill replica 0; every subsequent request must succeed on the
+    survivor, with the dead replica cooled down (marked out of rotation)."""
+    import time
+
+    router, stops = stack
+    stops[0].set()          # stop serve(): listener closes, connects refuse
+    time.sleep(0.7)         # let shutdown() + server_close() finish
+    m = RouterHandler.metrics
+    before_dead = m.dead_marks.total()
+    ok = 0
+    for i in range(4):
+        req = urllib.request.Request(
+            _url(router, "/v1/completions"),
+            data=json.dumps({"model": MODEL_NAME, "prompt": f"q{i}",
+                             "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert json.loads(r.read())["object"] == "text_completion"
+            ok += 1
+    assert ok == 4
+    # the dead replica was discovered and cooled down at least once
+    assert m.dead_marks.total() > before_dead
+    assert f"127.0.0.1:{BASE_PORT}" in RouterHandler.pool._dead
+
+
+class DyingStreamBackend(BaseHTTPRequestHandler):
+    """Streams two SSE chunks then drops the socket mid-body."""
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        import socket as _socket
+        import struct
+
+        n = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(n)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.end_headers()
+        self.wfile.write(b'data: {"choices":[{"text":"a"}]}\n\n')
+        self.wfile.write(b'data: {"choices":[{"text":"b"}]}\n\n')
+        self.wfile.flush()
+        # RST, not FIN: a clean close is how SSE legitimately ENDS (the
+        # router must treat it as end-of-stream); a crashed backend resets.
+        # os.close on the raw fd — socket.close() only drops a refcount
+        # while the handler's makefile objects keep the fd (and the
+        # connection) alive, so no RST would ever reach the router.
+        import os as _os
+        self.connection.setsockopt(_socket.SOL_SOCKET, _socket.SO_LINGER,
+                                   struct.pack("ii", 1, 0))
+        _os.close(self.connection.detach())   # die mid-stream (RST now)
+
+
+def test_mid_stream_backend_death_truncates_cleanly():
+    """A backend dying MID-STREAM must yield a truncated SSE body (no
+    [DONE], no spliced second response), mark the replica dead, and the
+    next request must fail over to the healthy replica."""
+    dying = ThreadingHTTPServer(("127.0.0.1", 0), DyingStreamBackend)
+    threading.Thread(target=dying.serve_forever, daemon=True).start()
+    stop = _start_engine(BASE_PORT + 2)
+
+    addrs = (f"127.0.0.1:{dying.server_port},"
+             f"127.0.0.1:{BASE_PORT + 2}")
+    old, oldm = RouterHandler.pool, RouterHandler.metrics
+
+    class DyingFirstPool(BackendPool):
+        def pick(self, affinity_key=None):
+            order = super().pick(affinity_key)
+            dying_addr = f"127.0.0.1:{dying.server_port}"
+            if dying_addr in order:
+                order.remove(dying_addr)
+                order.insert(0, dying_addr)
+            return order
+
+    RouterHandler.pool = DyingFirstPool(addrs, cooldown_s=30.0)
+    RouterHandler.metrics = RouterMetrics()
+    router = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.server_port}/v1/completions",
+            data=json.dumps({"model": MODEL_NAME, "prompt": "s",
+                             "max_tokens": 4, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                raw = r.read().decode(errors="replace")
+        except (urllib.error.HTTPError, ConnectionError, OSError):
+            raw = ""          # a hard cut is also a clean truncation
+        # truncated: whatever arrived is ONLY the dying backend's chunks —
+        # never a spliced second response or a [DONE] it didn't send
+        assert "[DONE]" not in raw
+        assert raw.count("HTTP/1.1") == 0
+        # the dying replica is out of rotation...
+        assert f"127.0.0.1:{dying.server_port}" in RouterHandler.pool._dead
+        # ...and the next (fresh) request fails over to the real engine
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.server_port}/v1/completions",
+            data=json.dumps({"model": MODEL_NAME, "prompt": "after",
+                             "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert json.loads(r.read())["object"] == "text_completion"
+    finally:
+        router.shutdown()
+        dying.shutdown()
+        stop.set()
+        RouterHandler.pool, RouterHandler.metrics = old, oldm
