@@ -674,6 +674,12 @@ class ServingConfig:
     # engine inside the reference's serving pods ships the same knob as
     # ``kv_cache_dtype``. See serving/kv_cache.py.
     kv_dtype: str = "auto"
+    # Weight storage dtype: "auto" keeps ``dtype``; "int8" applies weights-
+    # only per-out-channel quantization at engine start (models/quant.py) —
+    # half the weight HBM stream, the dominant bytes/token term below batch
+    # ~64 (PERF.md roofline). Compute stays bf16 on the MXU; the vLLM engine
+    # inside the reference's pods ships this as ``--quantization``.
+    weights_dtype: str = "auto"
     # Attention backend: "xla" (fused SDPA fallback) or "pallas" (custom kernel).
     attention_impl: str = "auto"
     checkpoint_dir: str = ""

@@ -291,7 +291,14 @@ def param_count(params) -> int:
 
 
 def _linear(x, p):
-    y = x @ p["kernel"]
+    if "scale" in p:
+        # Weights-only int8 (models/quant.py): the upcast fuses into the
+        # weight load (HBM streams half the bytes; the MXU still computes
+        # bf16) and the per-out-channel f32 scale folds after the matmul —
+        # exact because the scale is constant along the contraction axis.
+        y = ((x @ p["kernel"].astype(x.dtype)) * p["scale"]).astype(x.dtype)
+    else:
+        y = x @ p["kernel"]
     if "bias" in p:
         y = y + p["bias"]
     return y
@@ -348,7 +355,16 @@ def decoder_block(cfg: ModelConfig, p: dict, x: jnp.ndarray,
 def _embed_inputs(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                   positions: jnp.ndarray):
     """Shared forward preamble: token embedding + position tables."""
-    x = params["embed"]["weight"][tokens]
+    emb = params["embed"]
+    if "scale" in emb:
+        # int8 table (models/quant.py): dequantize the gathered rows with
+        # their per-vocab-row scales; activations take the model compute
+        # dtype, which the (never-quantized) norm weights carry.
+        dt = params["final_norm"]["weight"].dtype
+        x = (emb["weight"][tokens].astype(jnp.float32)
+             * emb["scale"][tokens][..., None]).astype(dt)
+    else:
+        x = emb["weight"][tokens]
     if cfg.embed_scale:
         # Gemma scales embeddings by sqrt(H); HF casts the scalar to the
         # embedding dtype BEFORE multiplying — match that for logit parity.
@@ -367,7 +383,14 @@ def _embed_inputs(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 def _final_logits(params: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     x = apply_norm(cfg, x, params["final_norm"])
     if cfg.tie_embeddings:
-        return x @ params["embed"]["weight"].T
+        emb = params["embed"]
+        if "scale" in emb:
+            # the tied-logits matmul re-reads the whole table every decode
+            # step — the int8 stream is where the embed quantization pays;
+            # per-vocab-row scales become per-logit-column scales here
+            return ((x @ emb["weight"].T.astype(x.dtype))
+                    * emb["scale"]).astype(x.dtype)
+        return x @ emb["weight"].T
     return _linear(x, params["lm_head"])
 
 

@@ -1,0 +1,100 @@
+"""Weights-only int8 quantization for the decode path.
+
+Decode is HBM-bandwidth-bound and below batch ~64 the WEIGHT stream dominates
+the bytes/token term (PERF.md roofline; VERDICT r3 next #7): int8 weights
+halve that term, which is the single biggest single-chip lever left. The
+scheme is the standard weights-only recipe the vLLM engine inside the
+reference's serving pods exposes as ``--quantization`` (SURVEY.md §2.2 row
+1), TPU-shaped:
+
+- **Symmetric per-out-channel scales**: each output channel stores
+  ``s = max|W[:, o]| / 127`` (float32) and ``q = round(W / s)`` (int8). No
+  zero points — symmetric quantization keeps the matmul a plain dot.
+- **Compute stays bf16 on the MXU**: XLA fuses the int8→bf16 upcast into the
+  weight load, so HBM traffic halves while the systolic array sees its
+  native dtype (int8×bf16 mixed matmuls would otherwise leave the MXU). The
+  per-channel scale folds in AFTER the matmul as one fused multiply —
+  ``(x @ q) * s  ==  x @ (q * s)`` exactly, because the scale is constant
+  along the contraction axis.
+- **Pytree-shaped like the bf16 params**: a quantized projection is the same
+  dict with ``kernel`` turned int8 plus a sibling ``scale`` leaf, so the
+  scan-over-layers body, shard_map specs, and checkpoint plumbing all keep
+  working; ``parallel/sharding.param_pspecs(quant_weights=True)`` emits the
+  matching scale specs (out-channel axes shard with their kernel's tp axis).
+
+What gets quantized: the seven per-layer projections (wq/wk/wv/wo and the
+MLP kernels), the embedding table (per-VOCAB-ROW scales — the tied-logits
+matmul re-reads the whole table every decode step, ~25% of Qwen3-0.6B's
+weight bytes), and an untied lm_head. Norms, biases, q/k norms, the MoE
+router, and learned position tables stay in the model dtype (tiny, and
+precision-critical). MoE EXPERT kernels are left unquantized for now —
+their gshard dispatch einsums contract over the expert axis and need their
+own scale layout; the attention stack of an MoE model still quantizes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
+
+# Per-layer projection kernels quantized for dense models. MoE models keep
+# their expert kernels (w_gate/w_up/w_down are [L, E, ...] there) in the
+# model dtype.
+_DENSE_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+_ATTN_LAYER_KEYS = ("wq", "wk", "wv", "wo")
+
+
+def _quant_kernel(w: jnp.ndarray, in_axis: int):
+    """Symmetric per-out-channel int8: returns (q int8, scale f32 with the
+    ``in_axis`` reduced away). The scale floor avoids divide-by-zero on
+    all-zero channels (init edge case)."""
+    w32 = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(w32), axis=in_axis) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    q = jnp.clip(jnp.round(w32 / jnp.expand_dims(s, in_axis)), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def weights_quantized(params: dict) -> bool:
+    """Whether ``params`` carries int8 weight leaves (scale siblings)."""
+    try:
+        return "scale" in params["layers"]["wq"]
+    except (KeyError, TypeError):
+        return False
+
+
+def quantize_params(params: dict, cfg: ModelConfig) -> dict:
+    """Quantize a bf16/f32 param pytree to weights-only int8 (see module
+    docstring for exactly which leaves). Pure function — returns a new tree;
+    jit-compiled so the rounding runs on-device in one fused program."""
+    layer_keys = _ATTN_LAYER_KEYS if cfg.num_experts > 0 else _DENSE_LAYER_KEYS
+
+    @jax.jit
+    def _go(params):
+        out = jax.tree.map(lambda x: x, params)   # shallow-ish copy
+        layers = dict(out["layers"])
+        for key in layer_keys:
+            if key not in layers:
+                continue
+            p = dict(layers[key])
+            # [L, in, out] → contract over in (axis 1); scale [L, out]
+            q, s = _quant_kernel(p["kernel"], in_axis=1)
+            p["kernel"], p["scale"] = q, s
+            layers[key] = p
+        out["layers"] = layers
+        emb = dict(out["embed"])
+        # [V, H]: per-vocab-row scales — the gather dequantizes one row per
+        # token; the tied-logits matmul folds them per output logit.
+        q, s = _quant_kernel(emb["weight"], in_axis=1)
+        emb["weight"], emb["scale"] = q, s
+        out["embed"] = emb
+        if "lm_head" in out:
+            p = dict(out["lm_head"])
+            q, s = _quant_kernel(p["kernel"], in_axis=0)   # [H, V] → [V]
+            p["kernel"], p["scale"] = q, s
+            out["lm_head"] = p
+        return out
+
+    return _go(params)

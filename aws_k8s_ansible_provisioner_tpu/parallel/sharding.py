@@ -49,19 +49,28 @@ def check_tp_divisibility(cfg: ModelConfig, tp: int, ep: int = 1) -> None:
                          f"{cfg.num_experts} for model {cfg.name}")
 
 
-def _layer_pspecs(cfg: ModelConfig) -> dict:
-    """PartitionSpecs mirroring models/layers.init_layer_params structure."""
+def _layer_pspecs(cfg: ModelConfig, quant_weights: bool = False) -> dict:
+    """PartitionSpecs mirroring models/layers.init_layer_params structure.
+
+    ``quant_weights`` adds the int8 scheme's per-out-channel ``scale`` leaves
+    (models/quant.py): a scale shards exactly like its kernel's OUT axis —
+    column-parallel kernels carry tp-sharded scales, row-parallel kernels
+    replicated ones (their out axis is replicated)."""
 
     def col(bias: bool) -> dict:  # [L, in, out] — shard out
         p = {"kernel": P(None, None, "tp")}
         if bias:
             p["bias"] = P(None, "tp")
+        if quant_weights:
+            p["scale"] = P(None, "tp")      # [L, out]
         return p
 
     def row(bias: bool) -> dict:  # [L, in, out] — shard in, replicate out
         p = {"kernel": P(None, "tp", None)}
         if bias:
             p["bias"] = P(None, None)
+        if quant_weights:
+            p["scale"] = P(None, None)      # [L, out] (out replicated)
         return p
 
     def norm() -> dict:
@@ -99,13 +108,21 @@ def _layer_pspecs(cfg: ModelConfig) -> dict:
     return specs
 
 
-def param_pspecs(cfg: ModelConfig) -> dict:
-    """Full-parameter PartitionSpec pytree (same structure as init_params)."""
+def param_pspecs(cfg: ModelConfig, quant_weights: bool = False) -> dict:
+    """Full-parameter PartitionSpec pytree (same structure as init_params;
+    with ``quant_weights`` the structure of models/quant.quantize_params —
+    MoE models quantize only their attention projections there, matching
+    the expert-key skip below)."""
     specs: dict = {
         "embed": {"weight": P("tp", None)},  # vocab-sharded
-        "layers": _layer_pspecs(cfg),
+        "layers": _layer_pspecs(cfg, quant_weights=quant_weights),
         "final_norm": {"weight": P(None)},
     }
+    if quant_weights:
+        # [V] per-vocab-row scales; MoE expert kernels never get scales
+        # (their specs are written explicitly in _layer_pspecs, and
+        # quantize_params skips them)
+        specs["embed"]["scale"] = P("tp")
     if cfg.pos_embed == "learned":
         # OPT position table: tiny, replicate.
         specs["pos_embed"] = {"weight": P(None, None)}
@@ -115,6 +132,8 @@ def param_pspecs(cfg: ModelConfig) -> dict:
         specs["lm_head"] = {"kernel": P(None, "tp")}
         if cfg.parallel_block:
             specs["lm_head"]["bias"] = P("tp")
+        if quant_weights:
+            specs["lm_head"]["scale"] = P("tp")   # [V]
     return specs
 
 
@@ -162,14 +181,21 @@ def tokens_pspec(seq_sharded: bool = False) -> P:
     return P("dp", "sp" if seq_sharded else None)
 
 
-def param_shardings(mesh: Mesh, cfg: ModelConfig) -> Any:
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs(cfg),
+def param_shardings(mesh: Mesh, cfg: ModelConfig,
+                    quant_weights: bool = False) -> Any:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        param_pspecs(cfg, quant_weights=quant_weights),
                         is_leaf=lambda x: isinstance(x, P))
 
 
 def shard_params(params: Any, mesh: Mesh, cfg: ModelConfig) -> Any:
-    """Place an (unsharded or host) param pytree onto the mesh per the rules."""
-    shardings = param_shardings(mesh, cfg)
+    """Place an (unsharded or host) param pytree onto the mesh per the rules.
+    Detects int8-quantized trees (models/quant.py) and picks the matching
+    spec structure."""
+    from aws_k8s_ansible_provisioner_tpu.models.quant import weights_quantized
+
+    shardings = param_shardings(mesh, cfg,
+                                quant_weights=weights_quantized(params))
     return jax.tree.map(jax.device_put, params, shardings)
 
 

@@ -523,6 +523,17 @@ class Engine:
         self.buckets = tuple(b for b in serving.prefill_buckets
                              if b <= self.max_len)
         dtype = jnp.bfloat16 if serving.dtype == "bfloat16" else jnp.float32
+        if serving.weights_dtype not in ("auto", "int8"):
+            raise ValueError(f"weights_dtype={serving.weights_dtype!r}: "
+                             f"expected 'auto' or 'int8'")
+        if serving.weights_dtype == "int8":
+            # Weights-only int8 (models/quant.py): quantized on host/device
+            # BEFORE the mesh sharding below, so each chip receives the
+            # int8 shard (half the transfer and half the resident bytes).
+            from aws_k8s_ansible_provisioner_tpu.models.quant import (
+                quantize_params)
+
+            self.params = params = quantize_params(params, cfg)
         if serving.kv_dtype not in ("auto", "int8"):
             # An unrecognized value (e.g. "fp8", "INT8") must not silently
             # degrade to the unquantized cache — capacity would halve with no

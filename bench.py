@@ -415,6 +415,10 @@ def measure() -> None:
         max_prefill_batch=int(env("TPU_BENCH_PREFILL_BATCH",
                                   32 if on_tpu else 4)),
         kv_dtype=kv_dtype,
+        # Weights-only int8 A/B (VERDICT r3 next #7): halves the dominant
+        # weight-stream term of bytes/token — the roofline ceiling moves
+        # automatically (weights_bytes reads the quantized tree).
+        weights_dtype=env("TPU_BENCH_WEIGHTS", "auto"),
         # Default matches ServingConfig.paged=True so the headline number
         # measures the path production actually executes (ADVICE r3). The
         # parent's retry attempt A/Bs TPU_BENCH_PAGED=0 so a paged-specific
@@ -469,7 +473,7 @@ def measure() -> None:
 
     def result_line(tps: float, partial: bool, extra: dict):
         mean_ctx = float(sum(engine.lengths[:n_slots]) / n_slots)
-        roof = _roofline(params, cfg, serving, mean_ctx, n_slots) \
+        roof = _roofline(engine.params, cfg, serving, mean_ctx, n_slots) \
             if on_tpu else {}
         out = {
             "metric": f"qwen3-0.6b decode tokens/sec/chip "
@@ -480,6 +484,7 @@ def measure() -> None:
             "platform": platform,
             "attention_impl": impl,
             "kv_dtype": serving.kv_dtype,
+            "weights_dtype": serving.weights_dtype,
             "paged": serving.paged,
             "ttft_p50_ms": round(ttft_p50_ms, 2),
             "batch": n_slots,

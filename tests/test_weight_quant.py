@@ -1,0 +1,152 @@
+"""Weights-only int8 quantization (models/quant.py): HF logit parity within
+quantization tolerance, engine integration, tp-mesh parity, and the HBM
+claim the bench roofline consumes.
+
+VERDICT r3 next #7: below batch ~64 the weight stream dominates bytes/token;
+int8 weights halve that term. The vLLM engine inside the reference's serving
+pods exposes the same capability as ``--quantization`` (SURVEY.md §2.2 row 1).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import (MeshConfig, ServingConfig,
+                                                    tiny_qwen3)
+from aws_k8s_ansible_provisioner_tpu.models import (convert_state_dict,
+                                                    model_forward)
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+from aws_k8s_ansible_provisioner_tpu.models.quant import (quantize_params,
+                                                          weights_quantized)
+from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
+
+
+def test_quantized_logits_close_to_hf():
+    """Quantized JAX logits vs the HF torch reference: within the error
+    budget weights-only int8 buys (per-weight error <= 1/254), top-1
+    agreement stays near-perfect. This is the 'HF logit-parity tolerance
+    test' of VERDICT r3 next #7."""
+    torch = pytest.importorskip("torch")
+    from tests.test_model_parity import _hf_qwen3
+
+    cfg = tiny_qwen3()
+    model = _hf_qwen3(cfg)
+    params = convert_state_dict(cfg, dict(model.state_dict()),
+                                dtype=jnp.float32)
+    qparams = quantize_params(params, cfg)
+    assert weights_quantized(qparams) and not weights_quantized(params)
+
+    rng = np.random.default_rng(0)
+    B, T = 2, 17
+    tokens = rng.integers(0, cfg.vocab_size, (B, T))
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens)).logits.float().numpy()
+    positions = np.broadcast_to(np.arange(T), (B, T))
+    logits, _ = model_forward(qparams, cfg, jnp.asarray(tokens, jnp.int32),
+                              jnp.asarray(positions, jnp.int32))
+    got = np.asarray(logits, np.float32)
+
+    # normalized error bound: int8 noise accumulates over layers but must
+    # stay a small fraction of the logit dynamic range
+    err = np.max(np.abs(got - ref)) / max(1e-6, np.max(np.abs(ref)))
+    assert err < 0.06, f"quantized logits off by {err:.3f} of logit range"
+    agree = (got.argmax(-1) == ref.argmax(-1)).mean()
+    assert agree >= 0.9, f"top-1 agreement {agree:.2f}"
+
+
+def test_quantized_weight_bytes_halved():
+    """The roofline input: the quantized tree must stream roughly half the
+    bytes (int8 kernels + small f32 scales vs bf16)."""
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.bfloat16)
+    qparams = quantize_params(params, cfg)
+    full = sum(x.nbytes for x in jax.tree.leaves(params))
+    quant = sum(x.nbytes for x in jax.tree.leaves(qparams))
+    assert quant < 0.62 * full, f"{quant}/{full} bytes"
+
+
+def test_quantized_pspecs_match_structure():
+    """param_pspecs(quant_weights=True) must mirror quantize_params' tree so
+    mesh placement (shard_params) maps every leaf — including scales."""
+    from jax.sharding import PartitionSpec as P
+
+    from aws_k8s_ansible_provisioner_tpu.parallel.sharding import param_pspecs
+
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    qparams = quantize_params(params, cfg)
+    specs = param_pspecs(cfg, quant_weights=True)
+    # tree_map raises on structure mismatch
+    jax.tree.map(lambda a, s: None, qparams, specs,
+                 is_leaf=lambda x: isinstance(x, P))
+
+
+def _run(engine, prompts, max_tokens=10):
+    reqs = [engine.submit(Request(prompt_ids=list(p), max_tokens=max_tokens,
+                                  ignore_eos=True)) for p in prompts]
+    for _ in range(10000):
+        if not engine.step():
+            break
+    return [r.generated for r in reqs]
+
+
+def test_quantized_engine_generates_and_is_deterministic():
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    serving = ServingConfig(max_decode_slots=4, max_cache_len=64,
+                            prefill_buckets=(16,), dtype="float32",
+                            weights_dtype="int8", prefix_cache=False)
+    prompts = [[3, 5, 7], [11, 2, 9, 4]]
+    a = _run(Engine(cfg, params, serving), prompts)
+    b = _run(Engine(cfg, params, serving), prompts)
+    assert a == b
+    assert all(len(g) == 10 for g in a)
+    # quantization actually happened inside the engine
+    eng = Engine(cfg, params, serving)
+    assert weights_quantized(eng.params)
+
+
+def test_quantized_under_tp_mesh_token_parity(cpu_devices):
+    """Same quantized weights, tp=2-sharded vs single-device: the scale
+    leaves shard with their kernels' out axes (parallel/sharding.py) and the
+    streams must be token-identical."""
+    from aws_k8s_ansible_provisioner_tpu.parallel.mesh import make_mesh
+
+    cfg = tiny_qwen3(num_heads=4, num_kv_heads=2, vocab_size=256)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    serving = ServingConfig(max_decode_slots=4, max_cache_len=64,
+                            prefill_buckets=(8, 16), dtype="float32",
+                            weights_dtype="int8")
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(2, cfg.vocab_size, n).tolist() for n in (3, 7, 12)]
+
+    expected = _run(Engine(cfg, params, serving), prompts, max_tokens=8)
+    mesh = make_mesh(MeshConfig(dp=1, tp=2), devices=jax.devices("cpu"))
+    got = _run(Engine(cfg, params, serving, mesh=mesh), prompts, max_tokens=8)
+    assert got == expected
+
+    # and the sharded scale really is distributed: lm-head/embed scales are
+    # vocab-sharded over tp
+    eng = Engine(cfg, params, serving, mesh=mesh)
+    s = eng.params["embed"]["scale"]
+    assert s.addressable_shards[0].data.shape[0] == cfg.vocab_size // 2
+
+
+def test_quantized_greedy_stream_mostly_tracks_fp():
+    """Not bit-parity (quantization legitimately perturbs near-ties) but the
+    quantized greedy stream must track the fp stream closely on a tiny
+    model — a layout/scale bug diverges immediately and completely."""
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    base = ServingConfig(max_decode_slots=2, max_cache_len=64,
+                         prefill_buckets=(16,), dtype="float32",
+                         prefix_cache=False)
+    q = dataclasses.replace(base, weights_dtype="int8")
+    prompts = [[5, 9, 2, 8]]
+    fp = _run(Engine(cfg, params, base), prompts, max_tokens=12)[0]
+    qs = _run(Engine(cfg, params, q), prompts, max_tokens=12)[0]
+    match = sum(a == b for a, b in zip(fp, qs)) / len(fp)
+    assert match >= 0.5, f"quantized stream diverged immediately: {match:.2f}"
