@@ -210,3 +210,64 @@ def test_preemption_preserves_penalty_counts(model):
     assert len(baseline) == 30
     assert preempted == baseline, \
         "penalty state diverged across preemption/resume"
+
+
+def test_followup_turn_hits_generated_pages(model):
+    """Multi-turn page reuse (ADVICE r3): a follow-up prompt containing the
+    PRIOR RESPONSE must prefix-hit past the original prompt — _finish now
+    indexes the generated region's full pages (minus the pending last row),
+    not just the prompt pages _activate indexed."""
+    eng = _engine(model)
+    # turn 1: one full prompt page (8 toks), 12 generated -> ids = 20 toks,
+    # full WRITTEN pages = floor((20 - 1) / 8) = 2 — the second page is
+    # entirely generated tokens
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    a = eng.submit(Request(prompt_ids=list(prompt), max_tokens=12,
+                           ignore_eos=True))
+    _drain(eng)
+    assert len(a.generated) == 12
+    reused0 = eng.metrics.prefix_tokens_reused.total()
+    # turn 2 (isolated arrival): prompt = turn-1 context + a new question
+    follow = prompt + a.generated + [7, 7, 7]
+    b = eng.submit(Request(prompt_ids=list(follow), max_tokens=4,
+                           ignore_eos=True))
+    _drain(eng)
+    assert len(b.generated) == 4
+    reused = eng.metrics.prefix_tokens_reused.total() - reused0
+    # 2 pages = 16 rows reused: past the 8-row prompt page, INTO the
+    # generated region
+    assert reused >= 2 * PS, f"only {reused} rows reused"
+    # and the reuse is correct: the follow-up's continuation matches a
+    # fresh engine given the identical full prompt
+    assert b.generated == _greedy_reference(model, follow, 4)
+
+
+def test_prefill_fairness_floor_keeps_decode_flowing(model):
+    """VERDICT r3 weak #5: under a sustained admission stream, prefill
+    priority alone holds running streams at a trickle. With the fairness
+    floor, a long-running request makes materially more progress over the
+    same number of steps."""
+    cfg, params = model
+
+    def run(fairness):
+        eng = Engine(cfg, params, ServingConfig(
+            max_decode_slots=2, max_cache_len=64, page_size=PS,
+            prefill_buckets=(8, 16, 32), dtype="float32",
+            decode_horizon=8, prefill_fairness=fairness,
+            prefix_cache=False))
+        long = eng.submit(Request(prompt_ids=[5, 4, 3], max_tokens=40,
+                                  ignore_eos=True))
+        shorts = []
+        for i in range(30):
+            # one new arrival per step: admission work never dries up
+            shorts.append(eng.submit(Request(prompt_ids=[7 + i % 9] * 4,
+                                             max_tokens=1, ignore_eos=True)))
+            eng.step()
+        return len(long.generated)
+
+    starved = run(fairness=0)       # pure prefill priority (pre-r4)
+    fair = run(fairness=2)
+    assert fair > starved, (starved, fair)
+    # with a floor of 2, every third dispatch is a full-horizon (8) decode:
+    # 30 steps -> ~10 forced decodes -> tens of tokens, vs a trickle
+    assert fair >= starved + 8, (starved, fair)
