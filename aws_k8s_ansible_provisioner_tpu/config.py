@@ -774,6 +774,7 @@ def ansible_vars(cfg: FrameworkConfig | None = None) -> str:
     d["serving_sp"] = cfg.serving.mesh.sp
     d["serving_ep"] = cfg.serving.mesh.ep
     d["serving_kv_dtype"] = cfg.serving.kv_dtype
+    d["serving_weights_dtype"] = cfg.serving.weights_dtype
     d["serving_spec_decode"] = cfg.serving.spec_decode
     lines = ["# generated by aws_k8s_ansible_provisioner_tpu.config — do not edit"]
     for k, v in d.items():

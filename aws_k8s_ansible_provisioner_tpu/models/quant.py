@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
 
@@ -65,13 +66,32 @@ def weights_quantized(params: dict) -> bool:
         return False
 
 
-def quantize_params(params: dict, cfg: ModelConfig) -> dict:
-    """Quantize a bf16/f32 param pytree to weights-only int8 (see module
-    docstring for exactly which leaves). Pure function — returns a new tree;
-    jit-compiled so the rounding runs on-device in one fused program."""
-    layer_keys = _ATTN_LAYER_KEYS if cfg.num_experts > 0 else _DENSE_LAYER_KEYS
+def _quant_kernel_host(w, in_axis: int):
+    """numpy twin of _quant_kernel: runs leaf-by-leaf on the HOST so no
+    device ever materializes the full unquantized tree."""
+    w32 = np.asarray(w).astype(np.float32)
+    s = np.max(np.abs(w32), axis=in_axis) / 127.0
+    s = np.maximum(s, 1e-12)
+    q = np.clip(np.round(w32 / np.expand_dims(s, in_axis)), -127, 127)
+    return q.astype(np.int8), s.astype(np.float32)
 
-    @jax.jit
+
+def quantize_params(params: dict, cfg: ModelConfig,
+                    host: bool = False) -> dict:
+    """Quantize a bf16/f32 param pytree to weights-only int8 (see module
+    docstring for exactly which leaves). Pure function — returns a new tree.
+
+    ``host=False``: one jit-compiled fused program — right when the params
+    already live (whole) on a single device (single-chip serving, bench).
+    ``host=True``: leaf-by-leaf numpy on the host — REQUIRED before mesh
+    sharding of a large checkpoint: the jitted path would device_put the
+    full unquantized tree onto one chip first, exactly the single-device
+    HBM peak the sharded loader exists to avoid (an 8B bf16 tree does not
+    fit one v5e chip). Engine picks host=True whenever it has a mesh.
+    """
+    layer_keys = _ATTN_LAYER_KEYS if cfg.num_experts > 0 else _DENSE_LAYER_KEYS
+    kern = _quant_kernel_host if host else _quant_kernel
+
     def _go(params):
         out = jax.tree.map(lambda x: x, params)   # shallow-ish copy
         layers = dict(out["layers"])
@@ -80,21 +100,21 @@ def quantize_params(params: dict, cfg: ModelConfig) -> dict:
                 continue
             p = dict(layers[key])
             # [L, in, out] → contract over in (axis 1); scale [L, out]
-            q, s = _quant_kernel(p["kernel"], in_axis=1)
+            q, s = kern(p["kernel"], in_axis=1)
             p["kernel"], p["scale"] = q, s
             layers[key] = p
         out["layers"] = layers
         emb = dict(out["embed"])
         # [V, H]: per-vocab-row scales — the gather dequantizes one row per
         # token; the tied-logits matmul folds them per output logit.
-        q, s = _quant_kernel(emb["weight"], in_axis=1)
+        q, s = kern(emb["weight"], in_axis=1)
         emb["weight"], emb["scale"] = q, s
         out["embed"] = emb
         if "lm_head" in out:
             p = dict(out["lm_head"])
-            q, s = _quant_kernel(p["kernel"], in_axis=0)   # [H, V] → [V]
+            q, s = kern(p["kernel"], in_axis=0)   # [H, V] → [V]
             p["kernel"], p["scale"] = q, s
             out["lm_head"] = p
         return out
 
-    return _go(params)
+    return _go(params) if host else jax.jit(_go)(params)

@@ -533,7 +533,13 @@ class Engine:
             from aws_k8s_ansible_provisioner_tpu.models.quant import (
                 quantize_params)
 
-            self.params = params = quantize_params(params, cfg)
+            # host=True under a mesh: leaf-wise numpy quantization so no
+            # single chip ever holds the full unquantized tree (the jitted
+            # path would device_put it whole — the 8B-on-v5e-8 OOM the
+            # sharded loader exists to avoid)
+            self.params = params = quantize_params(
+                params, cfg,
+                host=mesh is not None or serving.mesh.num_devices > 1)
         if serving.kv_dtype not in ("auto", "int8"):
             # An unrecognized value (e.g. "fp8", "INT8") must not silently
             # degrade to the unquantized cache — capacity would halve with no

@@ -765,6 +765,11 @@ def main(argv=None):
     p.add_argument("--kv-dtype", default="auto", choices=["auto", "int8"],
                    help="KV-cache storage dtype; int8 halves cache HBM "
                         "footprint/bandwidth (~2x the decode slots per chip)")
+    p.add_argument("--weights-dtype", default="auto",
+                   choices=["auto", "int8"],
+                   help="weight storage dtype; int8 halves the weight HBM "
+                        "stream (weights-only per-channel quantization; "
+                        "compute stays bf16 on the MXU)")
     p.add_argument("--chat-template", default="",
                    help="path to a Jinja chat template file")
     p.add_argument("--platform", default="",
@@ -788,7 +793,8 @@ def main(argv=None):
                    help="disable automatic prompt-prefix K/V reuse")
     p.add_argument("--spec-decode", action="store_true",
                    help="prompt-lookup speculative decoding (greedy-lossless "
-                        "multi-token steps; single-device only)")
+                        "multi-token steps; runs single-device and under "
+                        "pure-tp meshes)")
     p.add_argument("--spec-k", type=int, default=4,
                    help="draft tokens per speculative step")
     p.add_argument("--no-warmup", action="store_true")
@@ -828,7 +834,7 @@ def main(argv=None):
         model=args.model, port=args.port, host=args.host,
         max_decode_slots=args.max_decode_slots,
         max_cache_len=args.max_cache_len, dtype=args.dtype,
-        kv_dtype=args.kv_dtype,
+        kv_dtype=args.kv_dtype, weights_dtype=args.weights_dtype,
         checkpoint_dir=args.checkpoint_dir, chat_template=args.chat_template,
         prefill_chunk=args.prefill_chunk,
         prefix_cache=not args.no_prefix_cache,

@@ -25,9 +25,9 @@ import time
 DEFAULT_GRID = {
     # the questions worth chip time this round, cheapest first:
     # 1) do the paged block-table kernels match dense throughput?
-    # 2) does a bigger horizon still pay at int8/batch-128?
+    # 2) do int8 weights deliver the roofline shift (halved weight stream)?
     "TPU_BENCH_PAGED": ["0", "1"],
-    "TPU_BENCH_HORIZON": ["96", "128"],
+    "TPU_BENCH_WEIGHTS": ["auto", "int8"],
 }
 
 

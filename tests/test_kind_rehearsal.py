@@ -106,6 +106,17 @@ def _playbook_request_sequence():
         ("POST", "/v1/completions",
          {"prompt": "Who are you?", "max_tokens": 8},
          lambda body, model: body["choices"][0]["text"] is not None),
+        # API edges the r4 playbook exercises (serving-test.yaml): logit_bias
+        # and a usage-bearing stream
+        ("POST", "/v1/completions",
+         {"prompt": "Hi", "max_tokens": 4, "logit_bias": {"42": 5}},
+         lambda body, model: body["choices"][0]["finish_reason"]
+         in ("stop", "length")),
+        ("POST-RAW", "/v1/completions",
+         {"prompt": "Hi", "max_tokens": 4, "stream": True,
+          "stream_options": {"include_usage": True}},
+         lambda text, model: "completion_tokens" in text
+         and "[DONE]" in text),
         ("GET", "/metrics", None,
          lambda text, model: "tpu_serve_generated_tokens_total" in text),
     ]
@@ -135,6 +146,9 @@ def test_l4_request_sequence_offline():
     base = "http://127.0.0.1:18161"
     try:
         for method, path, payload, check in _playbook_request_sequence():
+            raw_mode = method == "POST-RAW"
+            if raw_mode:
+                method = "POST"
             if method == "GET":
                 with urllib.request.urlopen(base + path, timeout=60) as r:
                     raw = r.read()
@@ -145,7 +159,8 @@ def test_l4_request_sequence_offline():
                     headers={"Content-Type": "application/json"})
                 with urllib.request.urlopen(req, timeout=120) as r:
                     raw = r.read()
-            body = raw.decode() if path == "/metrics" else json.loads(raw)
+            body = raw.decode() if (path == "/metrics" or raw_mode) \
+                else json.loads(raw)
             assert check(body, model), f"{method} {path} contract failed"
     finally:
         stop.set()

@@ -150,3 +150,56 @@ def test_quantized_greedy_stream_mostly_tracks_fp():
     qs = _run(Engine(cfg, params, q), prompts, max_tokens=12)[0]
     match = sum(a == b for a, b in zip(fp, qs)) / len(fp)
     assert match >= 0.5, f"quantized stream diverged immediately: {match:.2f}"
+
+
+def test_host_and_device_quantization_agree():
+    """The host (numpy, leaf-wise — used before mesh sharding so no chip
+    holds the full unquantized tree) and jitted device paths must produce
+    identical int8 kernels and scales."""
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    dev = quantize_params(params, cfg, host=False)
+    host = quantize_params(params, cfg, host=True)
+    flat_d = jax.tree.leaves(dev)
+    flat_h = jax.tree.leaves(host)
+    assert len(flat_d) == len(flat_h)
+    for a, b in zip(flat_d, flat_h):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype and a.shape == b.shape
+        if a.dtype == np.int8:
+            # XLA vs numpy reduce/divide differ in the last ulp of the
+            # scale, which can flip a handful of exactly-half roundings by
+            # ±1 — semantically identical quantizations
+            diff = np.abs(a.astype(np.int32) - b.astype(np.int32))
+            assert diff.max(initial=0) <= 1
+            assert (diff > 0).mean() < 1e-3
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_all_features_compose():
+    """Kitchen sink: paged KV + int8 KV cache + int8 weights + speculative
+    decoding + prefix cache in ONE engine — the full shipped-default stack
+    plus every bandwidth lever — must generate the same stream as the same
+    quantized engine with each subsystem individually disabled (the
+    quantized PLAIN engine is the oracle; int8 weights legitimately perturb
+    streams vs fp, but the other subsystems must be invisible)."""
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    oracle_cfg = ServingConfig(max_decode_slots=4, max_cache_len=128,
+                               prefill_buckets=(32,), dtype="float32",
+                               weights_dtype="int8", paged=False,
+                               prefix_cache=False)
+    sink_cfg = dataclasses.replace(oracle_cfg, paged=True, page_size=32,
+                                   kv_dtype="int8", spec_decode=True,
+                                   spec_k=4, spec_ngram=3, prefix_cache=True,
+                                   attention_impl="pallas")
+    rng = np.random.default_rng(11)
+    pat = rng.integers(2, cfg.vocab_size, 4).tolist()
+    prompts = [pat * 4, rng.integers(2, cfg.vocab_size, 9).tolist()]
+
+    oracle = _run(Engine(cfg, params, oracle_cfg), prompts, max_tokens=16)
+    sink_eng = Engine(cfg, params, sink_cfg)
+    assert sink_eng.paged and weights_quantized(sink_eng.params)
+    got = _run(sink_eng, prompts, max_tokens=16)
+    assert got == oracle
