@@ -25,11 +25,13 @@ reference's serving pods exposes as ``--quantization`` (SURVEY.md §2.2 row
 What gets quantized: the seven per-layer projections (wq/wk/wv/wo and the
 MLP kernels), the embedding table (per-VOCAB-ROW scales — the tied-logits
 matmul re-reads the whole table every decode step, ~25% of Qwen3-0.6B's
-weight bytes), and an untied lm_head. Norms, biases, q/k norms, the MoE
-router, and learned position tables stay in the model dtype (tiny, and
-precision-critical). MoE EXPERT kernels are left unquantized for now —
-their gshard dispatch einsums contract over the expert axis and need their
-own scale layout; the attention stack of an MoE model still quantizes.
+weight bytes), an untied lm_head, and MoE EXPERT kernels (per-(expert,
+out-channel) scales — experts are ~95% of Qwen3-30B-A3B's bytes; both the
+ragged grouped matmuls and the gshard dispatch einsums contract over the
+hidden axis only, so the scale folds after them exactly, per expert row /
+expert slice — ops/moe.py). Norms, biases, q/k norms, the MoE router, and
+learned position tables stay in the model dtype (tiny, and
+precision-critical).
 """
 
 from __future__ import annotations
@@ -40,11 +42,12 @@ import numpy as np
 
 from aws_k8s_ansible_provisioner_tpu.config import ModelConfig
 
-# Per-layer projection kernels quantized for dense models. MoE models keep
-# their expert kernels (w_gate/w_up/w_down are [L, E, ...] there) in the
-# model dtype.
-_DENSE_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
-_ATTN_LAYER_KEYS = ("wq", "wk", "wv", "wo")
+# key -> contraction (in) axis of the per-layer kernel. Dense kernels are
+# [L, in, out] (axis 1); MoE expert kernels are [L, E, in, out] (axis 2).
+_DENSE_AXES = {"wq": 1, "wk": 1, "wv": 1, "wo": 1,
+               "w_gate": 1, "w_up": 1, "w_down": 1}
+_MOE_AXES = {"wq": 1, "wk": 1, "wv": 1, "wo": 1,
+             "w_gate": 2, "w_up": 2, "w_down": 2}
 
 
 def _quant_kernel(w: jnp.ndarray, in_axis: int):
@@ -89,18 +92,19 @@ def quantize_params(params: dict, cfg: ModelConfig,
     HBM peak the sharded loader exists to avoid (an 8B bf16 tree does not
     fit one v5e chip). Engine picks host=True whenever it has a mesh.
     """
-    layer_keys = _ATTN_LAYER_KEYS if cfg.num_experts > 0 else _DENSE_LAYER_KEYS
+    axes = _MOE_AXES if cfg.num_experts > 0 else _DENSE_AXES
     kern = _quant_kernel_host if host else _quant_kernel
 
     def _go(params):
         out = jax.tree.map(lambda x: x, params)   # shallow-ish copy
         layers = dict(out["layers"])
-        for key in layer_keys:
+        for key, in_axis in axes.items():
             if key not in layers:
                 continue
             p = dict(layers[key])
-            # [L, in, out] → contract over in (axis 1); scale [L, out]
-            q, s = kern(p["kernel"], in_axis=1)
+            # contract over the in axis; scale keeps the remaining axes
+            # (dense [L, out]; experts [L, E, out])
+            q, s = kern(p["kernel"], in_axis=in_axis)
             p["kernel"], p["scale"] = q, s
             layers[key] = p
         out["layers"] = layers

@@ -45,13 +45,24 @@ def route(cfg: ModelConfig, x: jnp.ndarray, router_kernel: jnp.ndarray):
     return w.astype(x.dtype), idx.astype(jnp.int32)
 
 
-def _expert_ffn_ragged(x: jnp.ndarray, p: dict, group_sizes: jnp.ndarray):
+def _expert_ffn_ragged(x: jnp.ndarray, p: dict, group_sizes: jnp.ndarray,
+                       expert_of_row=None):
     """SwiGLU over sorted token groups: x [M, H] grouped by expert;
-    kernels [E, H, I] / [E, I, H]."""
-    g = jax.lax.ragged_dot(x, p["w_gate"]["kernel"], group_sizes)
-    u = jax.lax.ragged_dot(x, p["w_up"]["kernel"], group_sizes)
-    return jax.lax.ragged_dot(jax.nn.silu(g) * u,
-                              p["w_down"]["kernel"], group_sizes)
+    kernels [E, H, I] / [E, I, H]. With int8 expert kernels
+    (models/quant.py: sibling ``scale`` [E, out]) the upcast fuses into the
+    grouped matmul and the per-(expert, out-channel) scale folds after it —
+    ``expert_of_row`` [M] maps each sorted row to its expert's scale row."""
+
+    def mm(v, q):
+        if "scale" in q:
+            out = jax.lax.ragged_dot(v, q["kernel"].astype(v.dtype),
+                                     group_sizes)
+            return (out * q["scale"][expert_of_row]).astype(v.dtype)
+        return jax.lax.ragged_dot(v, q["kernel"], group_sizes)
+
+    g = mm(x, p["w_gate"])
+    u = mm(x, p["w_up"])
+    return mm(jax.nn.silu(g) * u, p["w_down"])
 
 
 def moe_mlp_ragged(cfg: ModelConfig, x: jnp.ndarray, p: dict) -> jnp.ndarray:
@@ -71,7 +82,8 @@ def moe_mlp_ragged(cfg: ModelConfig, x: jnp.ndarray, p: dict) -> jnp.ndarray:
     tok = order // k                                           # source token
     xs = x[tok]                                                # [N*k, H]
     group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
-    ys = _expert_ffn_ragged(xs, p, group_sizes)                # [N*k, H]
+    ys = _expert_ffn_ragged(xs, p, group_sizes,
+                            expert_of_row=flat_e[order])       # [N*k, H]
     wflat = w.reshape(-1)[order]
     out = jnp.zeros_like(x)
     return out.at[tok].add((ys * wflat[:, None]).astype(x.dtype))
@@ -108,10 +120,18 @@ def moe_mlp_gshard(cfg: ModelConfig, x: jnp.ndarray, p: dict) -> jnp.ndarray:
     combine = jnp.einsum("nk,nke,nkc->nec", w * keep, oe, onehot_c)
     dispatch = jnp.einsum("nk,nke,nkc->nec", keep, oe, onehot_c)
     xe = jnp.einsum("nec,nh->ech", dispatch, x)                     # [E, C, H]
-    g = jnp.einsum("ech,ehi->eci", xe, p["w_gate"]["kernel"])
-    u = jnp.einsum("ech,ehi->eci", xe, p["w_up"]["kernel"])
-    y = jnp.einsum("eci,eih->ech", jax.nn.silu(g) * u,
-                   p["w_down"]["kernel"])                           # [E, C, H]
+
+    def mm(spec, v, q):
+        # int8 expert kernels: upcast fuses into the einsum load; the
+        # [E, out] scale broadcasts over the capacity axis afterwards
+        if "scale" in q:
+            out = jnp.einsum(spec, v, q["kernel"].astype(v.dtype))
+            return (out * q["scale"][:, None, :]).astype(v.dtype)
+        return jnp.einsum(spec, v, q["kernel"])
+
+    g = mm("ech,ehi->eci", xe, p["w_gate"])
+    u = mm("ech,ehi->eci", xe, p["w_up"])
+    y = mm("eci,eih->ech", jax.nn.silu(g) * u, p["w_down"])         # [E, C, H]
     return jnp.einsum("nec,ech->nh", combine, y).astype(x.dtype)
 
 

@@ -93,11 +93,18 @@ def _layer_pspecs(cfg: ModelConfig, quant_weights: bool = False) -> dict:
         # MoE: experts sharded over ep, each expert Megatron-split over tp
         # (gate/up column-parallel on the expert intermediate, down row-
         # parallel); the tiny router replicates. GSPMD derives the gshard
-        # dispatch collectives from these specs (ops/moe.py).
+        # dispatch collectives from these specs (ops/moe.py). Quantized
+        # expert scales [L, E, out] shard with their kernel's expert + out
+        # axes (gate/up out = tp-sharded intermediate; down out = replicated
+        # hidden).
         specs["router"] = {"kernel": P(None, None, None)}
         specs["w_gate"] = {"kernel": P(None, "ep", None, "tp")}
         specs["w_up"] = {"kernel": P(None, "ep", None, "tp")}
         specs["w_down"] = {"kernel": P(None, "ep", "tp", None)}
+        if quant_weights:
+            specs["w_gate"]["scale"] = P(None, "ep", "tp")
+            specs["w_up"]["scale"] = P(None, "ep", "tp")
+            specs["w_down"]["scale"] = P(None, "ep", None)
     else:
         if cfg.gated_mlp:
             specs["w_gate"] = col(cfg.mlp_bias)
@@ -110,19 +117,15 @@ def _layer_pspecs(cfg: ModelConfig, quant_weights: bool = False) -> dict:
 
 def param_pspecs(cfg: ModelConfig, quant_weights: bool = False) -> dict:
     """Full-parameter PartitionSpec pytree (same structure as init_params;
-    with ``quant_weights`` the structure of models/quant.quantize_params —
-    MoE models quantize only their attention projections there, matching
-    the expert-key skip below)."""
+    with ``quant_weights`` the structure of models/quant.quantize_params,
+    including MoE expert scales)."""
     specs: dict = {
         "embed": {"weight": P("tp", None)},  # vocab-sharded
         "layers": _layer_pspecs(cfg, quant_weights=quant_weights),
         "final_norm": {"weight": P(None)},
     }
     if quant_weights:
-        # [V] per-vocab-row scales; MoE expert kernels never get scales
-        # (their specs are written explicitly in _layer_pspecs, and
-        # quantize_params skips them)
-        specs["embed"]["scale"] = P("tp")
+        specs["embed"]["scale"] = P("tp")    # [V] per-vocab-row
     if cfg.pos_embed == "learned":
         # OPT position table: tiny, replicate.
         specs["pos_embed"] = {"weight": P(None, None)}

@@ -203,3 +203,68 @@ def test_all_features_compose():
     assert sink_eng.paged and weights_quantized(sink_eng.params)
     got = _run(sink_eng, prompts, max_tokens=16)
     assert got == oracle
+
+
+def test_quantized_moe_logits_close_to_fp():
+    """MoE expert kernels quantize with per-(expert, out-channel) scales;
+    the exact ragged path's logits must stay within the int8 error budget of
+    the fp forward (experts are ~95% of Qwen3-30B-A3B's weight bytes — the
+    whole point of quantizing them)."""
+    from aws_k8s_ansible_provisioner_tpu.config import tiny_qwen3_moe
+
+    cfg = tiny_qwen3_moe()
+    params = init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
+    qparams = quantize_params(params, cfg)
+    assert "scale" in qparams["layers"]["w_gate"]
+    assert qparams["layers"]["w_gate"]["kernel"].dtype == jnp.int8
+
+    rng = np.random.default_rng(5)
+    B, T = 2, 9
+    tokens = rng.integers(0, cfg.vocab_size, (B, T)).astype(np.int32)
+    positions = np.broadcast_to(np.arange(T, dtype=np.int32), (B, T))
+    ref, _ = model_forward(params, cfg, jnp.asarray(tokens),
+                           jnp.asarray(positions))
+    got, _ = model_forward(qparams, cfg, jnp.asarray(tokens),
+                           jnp.asarray(positions))
+    ref, got = np.asarray(ref, np.float32), np.asarray(got, np.float32)
+    err = np.max(np.abs(got - ref)) / max(1e-6, np.max(np.abs(ref)))
+    assert err < 0.06, f"quantized MoE logits off by {err:.3f}"
+    agree = (got.argmax(-1) == ref.argmax(-1)).mean()
+    assert agree >= 0.9, f"top-1 agreement {agree:.2f}"
+
+
+def test_quantized_moe_gshard_matches_ragged(cpu_devices):
+    """Quantized gshard (the ep-sharded distributed path) vs quantized exact
+    ragged on the same weights: the dispatch einsums' scale fold must not
+    change the math (ample capacity → no drops)."""
+    from jax.sharding import NamedSharding
+
+    from aws_k8s_ansible_provisioner_tpu.config import tiny_qwen3_moe
+    from aws_k8s_ansible_provisioner_tpu.parallel.mesh import make_mesh
+    from aws_k8s_ansible_provisioner_tpu.parallel.sharding import (
+        param_shardings, tokens_pspec)
+
+    cfg = tiny_qwen3_moe(num_heads=4, num_kv_heads=2,
+                         moe_capacity_factor=8.0)
+    params = init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    qparams = quantize_params(params, cfg)
+
+    rng = np.random.default_rng(6)
+    B, T = 2, 8
+    tokens = rng.integers(0, cfg.vocab_size, (B, T)).astype(np.int32)
+    positions = np.broadcast_to(np.arange(T, dtype=np.int32), (B, T))
+    ref, _ = model_forward(qparams, cfg.scaled(moe_impl="ragged"),
+                           jnp.asarray(tokens), jnp.asarray(positions))
+
+    mesh = make_mesh(MeshConfig(dp=1, ep=2, tp=2),
+                     devices=jax.devices("cpu")[:4])
+    shardings = param_shardings(mesh, cfg, quant_weights=True)
+    sharded = jax.tree.map(jax.device_put, qparams, shardings)
+    gcfg = cfg.scaled(moe_impl="gshard")
+    fwd = jax.jit(lambda p, t, pos: model_forward(p, gcfg, t, pos)[0],
+                  in_shardings=(shardings,
+                                NamedSharding(mesh, tokens_pspec()),
+                                NamedSharding(mesh, tokens_pspec())))
+    got = fwd(sharded, jnp.asarray(tokens), jnp.asarray(positions))
+    err = float(np.max(np.abs(np.asarray(got) - np.asarray(ref))))
+    assert err < 1e-3, f"ep-sharded quantized MoE diverged: max err {err}"
