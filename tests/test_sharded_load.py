@@ -159,3 +159,38 @@ def test_tp8_serving_config_runnable(cfg, hf_dir, cpu_devices):
     got = run(ServingConfig(**base, mesh=MeshConfig(dp=2, tp=2)))
     assert got == expected
     assert all(len(g) == 6 for g in got)
+
+
+def test_checkpoint_to_quantized_sharded_engine(cfg, hf_dir, mesh):
+    """The FLAGSHIP 8B serving flow end-to-end, scaled down: HF checkpoint →
+    load (sharded or host) → engine with weights_dtype=int8 over a tp mesh →
+    token parity with the quantized single-device engine. The engine's
+    host-path quantization (models/quant.py) + quant-aware shard_params must
+    place every int8 kernel AND scale leaf with its mesh sharding."""
+    from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
+
+    serving = ServingConfig(max_decode_slots=4, max_cache_len=64,
+                            prefill_buckets=(8, 16), dtype="float32",
+                            weights_dtype="int8")
+    plain = load_checkpoint(str(hf_dir), cfg, jnp.float32)
+
+    def run(engine):
+        rng = np.random.default_rng(9)
+        reqs = [engine.submit(Request(
+            prompt_ids=rng.integers(2, cfg.vocab_size, n).tolist(),
+            max_tokens=8, ignore_eos=True)) for n in (3, 7)]
+        for _ in range(10000):
+            if not engine.step():
+                break
+        return [r.generated for r in reqs]
+
+    expected = run(Engine(cfg, plain, serving))
+    meshed = Engine(cfg, plain, serving, mesh=mesh)
+    got = run(meshed)
+    assert got == expected
+    # every quantized leaf (incl. scales) landed sharded per its spec
+    flat, _ = jax.tree_util.tree_flatten_with_path(meshed.params)
+    int8_leaves = sum(1 for _, leaf in flat if leaf.dtype == jnp.int8)
+    assert int8_leaves >= 8, "expected int8 kernels across the tree"
+    for path, leaf in flat:
+        _assert_leaf_sharded(jax.tree_util.keystr(path), leaf, mesh)
