@@ -434,8 +434,10 @@ def make_prefill_attend(slot: jnp.ndarray, seq_len: jnp.ndarray,
 # ---------------------------------------------------------------------------
 # Paged variants (serving/paged_kv.py pool + block tables). Same contracts as
 # their dense counterparts; the ONLY difference is physical addressing via the
-# per-slot page table. Single-device path (the dp/tp/sp mesh serves the dense
-# layout; a per-dp-group pool is future work, documented in ServingConfig).
+# per-slot page table. Compose with tp meshes (heads sharded over the pool)
+# and dp meshes (page axis partitioned per dp group; tables carry GLOBAL ids
+# the shard_map bodies rebase — parallel/sharding.pool_pspecs). Only sp
+# serves the dense layout (a page is a contiguous row run).
 # ---------------------------------------------------------------------------
 
 

@@ -307,8 +307,19 @@ class RouterHandler(BaseHTTPRequestHandler):
 
     def _proxy(self, method: str):
         if self.path == "/health":
+            now = time.monotonic()
+            with self.pool._lock:
+                loads = {a: self.pool._load[a][0]
+                         for a in self.pool._addrs
+                         if a in self.pool._load
+                         and now - self.pool._load[a][1] <= LOAD_TTL_S}
+                dead = sorted(self.pool._dead)
             self._respond_json(200, {"status": "ok",
-                                     "backends": self.pool._addrs})
+                                     "backends": self.pool._addrs,
+                                     # fresh per-replica active+queued from
+                                     # the /load poller; absent = unknown
+                                     "backend_load": loads,
+                                     "cooling_down": dead})
             return
         if self.path == "/metrics":
             # The router's OWN counters (not proxied): the engine pods are
