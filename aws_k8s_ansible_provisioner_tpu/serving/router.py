@@ -233,17 +233,32 @@ def _affinity_key(path: str, body: bytes | None) -> str | None:
     try:
         obj = json.loads(body)
         if path.startswith("/v1/chat/completions"):
-            text = json.dumps(obj.get("messages", ""), sort_keys=True)
+            # Conversation identity, not raw serialized-prefix: a shared
+            # system prompt >= the prefix window would collapse EVERY chat
+            # onto one key (review r4). The whole system text plus the
+            # first non-system turn distinguishes conversations, while a
+            # follow-up turn of the same conversation (same system + same
+            # first user message, longer history) keeps its key — exactly
+            # the requests whose prior-turn pages the engine indexed.
+            msgs = obj.get("messages") or []
+            if not isinstance(msgs, list) or not msgs:
+                return None
+            sys_txt = "".join(str(m.get("content", "")) for m in msgs
+                              if isinstance(m, dict)
+                              and m.get("role") == "system")
+            first_turn = next((str(m.get("content", "")) for m in msgs
+                               if isinstance(m, dict)
+                               and m.get("role") != "system"), "")
+            text = sys_txt + "\x00" + first_turn[:AFFINITY_PREFIX_CHARS]
         else:
             prompt = obj.get("prompt", "")
             if isinstance(prompt, list):
                 prompt = prompt[0] if prompt else ""
             text = prompt if isinstance(prompt, str) else ""
-        if not text:
+            text = text[:AFFINITY_PREFIX_CHARS]
+        if not text.strip("\x00"):
             return None
-        return hashlib.sha1(
-            text[:AFFINITY_PREFIX_CHARS].encode("utf-8", "replace")
-        ).hexdigest()
+        return hashlib.sha1(text.encode("utf-8", "replace")).hexdigest()
     except (ValueError, TypeError, AttributeError):
         return None
 
@@ -254,26 +269,44 @@ def start_load_poller(pool: BackendPool, interval_s: float = 1.0,
     fails the poll just loses its (stale-TTL'd) sample — the request path's
     connect failures own dead-marking."""
 
+    def poll_one(addr):
+        host, _, port = addr.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=2.0)
+        try:
+            conn.request("GET", "/load")
+            resp = conn.getresponse()
+            if resp.status == 200:
+                d = json.loads(resp.read())
+                if isinstance(d, dict):
+                    pool.note_load(addr, d.get("active", 0) or 0,
+                                   d.get("queued", 0) or 0)
+        except Exception:
+            # NEVER let a malformed reply kill the poller thread — the
+            # router would silently degrade to round-robin for its whole
+            # lifetime (review r4). A failed poll just leaves the
+            # replica's sample to the stale-TTL.
+            log.debug("load poll of %s failed", addr, exc_info=True)
+        finally:
+            conn.close()
+
     def poll_once():
-        for addr in pool.addrs():
-            host, _, port = addr.rpartition(":")
-            conn = http.client.HTTPConnection(host, int(port), timeout=2.0)
-            try:
-                conn.request("GET", "/load")
-                resp = conn.getresponse()
-                if resp.status == 200:
-                    d = json.loads(resp.read())
-                    if isinstance(d, dict):
-                        pool.note_load(addr, d.get("active", 0) or 0,
-                                       d.get("queued", 0) or 0)
-            except Exception:
-                # NEVER let a malformed reply kill the poller thread — the
-                # router would silently degrade to round-robin for its whole
-                # lifetime (review r4). A failed poll just leaves the
-                # replica's sample to the stale-TTL.
-                log.debug("load poll of %s failed", addr, exc_info=True)
-            finally:
-                conn.close()
+        addrs = pool.addrs()
+        now = time.monotonic()
+        with pool._lock:
+            cooling = {a for a, t in pool._dead.items()
+                       if now - t < pool.cooldown_s}
+        # CONCURRENT polls, skipping cooled-down replicas: a few blackholed
+        # pod IPs during a rolling restart must not stretch the cycle past
+        # LOAD_TTL_S and stale out every healthy sample (review r4)
+        threads = []
+        for addr in addrs:
+            if addr in cooling:
+                continue
+            t = threading.Thread(target=poll_one, args=(addr,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=2.5)
 
     def run():
         while stop is None or not stop.is_set():
@@ -313,7 +346,11 @@ class RouterHandler(BaseHTTPRequestHandler):
                          for a in self.pool._addrs
                          if a in self.pool._load
                          and now - self.pool._load[a][1] <= LOAD_TTL_S}
-                dead = sorted(self.pool._dead)
+                # same expiry pick() applies — a router receiving only
+                # health probes must not report recovered replicas as
+                # cooling down forever (review r4)
+                dead = sorted(a for a, t in self.pool._dead.items()
+                              if now - t < self.pool.cooldown_s)
             self._respond_json(200, {"status": "ok",
                                      "backends": self.pool._addrs,
                                      # fresh per-replica active+queued from

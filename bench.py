@@ -234,6 +234,14 @@ def main() -> None:
         if ok:
             window = remaining() - CPU_RESERVE_S
             if window < MIN_ATTEMPT_S:
+                # the tunnel recovered too late for a real attempt: say so,
+                # or the CPU fallback would read as a healthy round's
+                # headline (review r4: finish() only labels unavailability
+                # when NO probe succeeded)
+                errors.append(
+                    f"tpu probe ok at t={probes[-1]['t']}s but only "
+                    f"{window:.0f}s left (< {MIN_ATTEMPT_S:.0f}s attempt "
+                    f"minimum)")
                 break
             # First attempt = shipped default (paged); retry A/Bs dense so a
             # paged-only lowering failure can't zero the round. An operator

@@ -355,3 +355,55 @@ def test_same_prefix_requests_stick_to_one_backend():
         for s in srvs:
             s.shutdown()
         RouterHandler.pool, RouterHandler.metrics = old, oldm
+
+
+def test_chat_affinity_is_conversation_identity():
+    """Chat keys must identify the CONVERSATION (full system text + first
+    non-system turn), not the serialized prefix: a shared system prompt
+    longer than the prefix window must not collapse every chat onto one key,
+    and a follow-up turn of the same conversation must keep its key."""
+    from aws_k8s_ansible_provisioner_tpu.serving.router import _affinity_key
+
+    sys_msg = {"role": "system", "content": "You are helpful. " * 100}
+
+    def key(msgs):
+        return _affinity_key("/v1/chat/completions",
+                             json.dumps({"messages": msgs}).encode())
+
+    conv_a1 = [sys_msg, {"role": "user", "content": "plan my trip"}]
+    conv_a2 = conv_a1 + [{"role": "assistant", "content": "sure..."},
+                         {"role": "user", "content": "now day 2"}]
+    conv_b = [sys_msg, {"role": "user", "content": "write a poem"}]
+    assert key(conv_a1) == key(conv_a2), \
+        "follow-up turn lost its conversation's affinity key"
+    assert key(conv_a1) != key(conv_b), \
+        "distinct conversations collapsed onto one key (system-prompt hash)"
+    assert key([sys_msg]) is None or key([sys_msg]) != key(conv_a1)
+
+
+def test_poller_skips_cooling_replicas():
+    """A cooled-down replica must not be polled (a few blackholed IPs would
+    otherwise stretch the cycle past LOAD_TTL_S and stale every sample)."""
+    import time as _t
+
+    from aws_k8s_ansible_provisioner_tpu.serving.router import (
+        start_load_poller)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), LoadReportingEngine)
+    srv.fake_active = 1
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    live = f"127.0.0.1:{srv.server_port}"
+    dead = "127.255.255.254:9"
+    pool = BackendPool(f"{live},{dead}", cooldown_s=60)
+    pool.mark_dead(dead)
+    stop = threading.Event()
+    start_load_poller(pool, interval_s=0.1, stop=stop)
+    try:
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline and live not in pool._load:
+            _t.sleep(0.05)
+        assert live in pool._load
+        assert dead not in pool._load
+    finally:
+        stop.set()
+        srv.shutdown()
