@@ -268,3 +268,20 @@ def test_quantized_moe_gshard_matches_ragged(cpu_devices):
     got = fwd(sharded, jnp.asarray(tokens), jnp.asarray(positions))
     err = float(np.max(np.abs(np.asarray(got) - np.asarray(ref))))
     assert err < 1e-3, f"ep-sharded quantized MoE diverged: max err {err}"
+
+
+def test_quantized_moe_engine_generates():
+    """MoE + int8 weights through the full serving engine (the ragged
+    expert path inside the fused decode scan, expert scales gathered per
+    sorted row): generates the full budget and matches its own rerun."""
+    from aws_k8s_ansible_provisioner_tpu.config import tiny_qwen3_moe
+
+    cfg = tiny_qwen3_moe()
+    params = init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
+    serving = ServingConfig(max_decode_slots=2, max_cache_len=64,
+                            prefill_buckets=(16,), dtype="float32",
+                            weights_dtype="int8", prefix_cache=False)
+    prompts = [[4, 9, 2], [7, 3, 5, 1]]
+    a = _run(Engine(cfg, params, serving), prompts, max_tokens=8)
+    b = _run(Engine(cfg, params, serving), prompts, max_tokens=8)
+    assert a == b and all(len(g) == 8 for g in a)
