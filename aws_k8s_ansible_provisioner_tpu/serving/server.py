@@ -319,8 +319,7 @@ class Handler(BaseHTTPRequestHandler):
             return self._error(400, "'n' must be an integer")
         if n_choices < 1 or n_choices > 8:
             return self._error(400, "'n' must be in [1, 8]")
-        if stream and n_choices > 1:
-            return self._error(400, "n > 1 with stream=true is not supported")
+
         # OpenAI ``seed``: deterministic sampling (engine keys each draw by
         # (seed, position) — ops/sampling.per_slot_keys). Sibling choices get
         # seed + i so n > 1 still returns distinct samples, with choice 0
@@ -339,8 +338,6 @@ class Handler(BaseHTTPRequestHandler):
         if echo and chat:
             return self._error(400, "'echo' is not supported on chat "
                                     "completions")
-        if echo and stream:
-            return self._error(400, "echo with stream=true is not supported")
         # OpenAI ``best_of`` (completions only): generate best_of candidates
         # server-side, return the n best by cumulative logprob. Candidates
         # ride the same continuous batch; ranking uses the engine's
@@ -355,9 +352,10 @@ class Handler(BaseHTTPRequestHandler):
         if best_of < n_choices or best_of > 8:
             return self._error(400, f"'best_of' must be in [n, 8], got "
                                     f"{best_of}")
-        if stream and best_of > 1:
-            return self._error(400, "best_of > 1 with stream=true is not "
-                                    "supported")
+        if stream and best_of > n_choices:
+            return self._error(400, "best_of > n with stream=true is not "
+                                    "supported (ranking needs complete "
+                                    "candidates)")
         # OpenAI logprobs: completions take an int ``logprobs`` (0 = chosen-
         # token only — still enabled; absent/null = off); chat takes
         # ``logprobs: true`` + ``top_logprobs: N`` (explicit 0 respected).
@@ -451,9 +449,10 @@ class Handler(BaseHTTPRequestHandler):
 
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         if stream:
-            self._stream_response(reqs[0], rid, chat, stops,
+            self._stream_response(reqs, rid, chat, stops,
                                   n_prompt=len(prompt_ids),
-                                  include_usage=include_usage)
+                                  include_usage=include_usage,
+                                  echo_text=prompt_text if echo else None)
         else:
             self._full_response(reqs, rid, chat, stops, len(prompt_ids),
                                 n_choices=n_choices,
@@ -530,9 +529,10 @@ class Handler(BaseHTTPRequestHandler):
                          "created": _now(), "model": st.model_name,
                          "choices": choices, "usage": usage})
 
-    def _stream_response(self, req, rid: str, chat: bool, stops: List[str],
-                         n_prompt: int = 0, include_usage: bool = False):
-        """SSE streaming with incremental detokenization.
+    def _stream_response(self, reqs, rid: str, chat: bool, stops: List[str],
+                         n_prompt: int = 0, include_usage: bool = False,
+                         echo_text: Optional[str] = None):
+        """SSE streaming with incremental detokenization (n choices).
 
         Correctness over eagerness: text is held back while it could still be
         (a) the tail of an incomplete multi-byte character (detokenizer handles
@@ -556,9 +556,9 @@ class Handler(BaseHTTPRequestHandler):
 
         obj = "chat.completion.chunk" if chat else "text_completion"
 
-        def chunk(delta_text: Optional[str], finish_reason: Optional[str],
-                  role: bool = False):
-            payload = {"index": 0, "finish_reason": finish_reason}
+        def chunk(idx: int, delta_text: Optional[str],
+                  finish_reason: Optional[str], role: bool = False):
+            payload = {"index": idx, "finish_reason": finish_reason}
             if chat:
                 d = {}
                 if role:
@@ -577,32 +577,66 @@ class Handler(BaseHTTPRequestHandler):
                 body["usage"] = None
             raw_write(f"data: {json.dumps(body)}\n\n".encode())
 
-        detok = IncrementalDetokenizer(st.tokenizer)
+        # Per-choice state: the n > 1 sibling requests ride the same
+        # continuous batch, so their tokens arrive interleaved — each choice
+        # detokenizes, stop-string-holds, and finishes independently, tagged
+        # by its chunk "index" (the OpenAI multi-choice stream shape).
         hold = max((len(s) for s in stops if s), default=1) - 1
-        pending = ""
-        finish: Optional[str] = None
+        states = [{"req": r, "detok": IncrementalDetokenizer(st.tokenizer),
+                   "pending": "", "finish": None} for r in reqs]
+        multi = len(states) > 1
+
+        def drain(i: int, block_s: float) -> bool:
+            """Advance choice i by at most one queue item; emit any ready
+            text. Returns whether an item arrived."""
+            s = states[i]
+            try:
+                item = s["req"].out_queue.get(timeout=block_s)
+            except queue.Empty:
+                return False
+            if item is None:
+                s["pending"] += s["detok"].finish()
+                s["finish"] = s["req"].finish_reason or "stop"
+            else:
+                s["pending"] += s["detok"].push(item)
+            cut_text = _apply_stop_strings(s["pending"], stops)
+            if cut_text is not None:
+                s["pending"], s["finish"] = cut_text, "stop"
+                st.engine.cancel(s["req"])  # free the slot; rest discarded
+            ready = s["pending"] if s["finish"] else (
+                s["pending"][:len(s["pending"]) - hold] if hold
+                else s["pending"])
+            if ready:
+                chunk(i, ready, None)
+                s["pending"] = s["pending"][len(ready):]
+            if s["finish"]:
+                chunk(i, None, s["finish"])
+            return True
+
         try:
-            if chat:
-                chunk("", None, role=True)
-            while finish is None:
-                item = req.out_queue.get(timeout=600)
-                if item is None:
-                    pending += detok.finish()
-                    finish = req.finish_reason or "stop"
-                else:
-                    pending += detok.push(item)
-                cut_text = _apply_stop_strings(pending, stops)
-                if cut_text is not None:
-                    pending, finish = cut_text, "stop"
-                    st.engine.cancel(req)  # free the slot; rest is discarded
-                ready = pending if finish else (
-                    pending[:len(pending) - hold] if hold else pending)
-                if ready:
-                    chunk(ready, None)
-                    pending = pending[len(ready):]
-            chunk(None, finish)
+            for i in range(len(states)):
+                if chat:
+                    chunk(i, "", None, role=True)
+                elif echo_text:
+                    # completions echo+stream: the prompt leads each
+                    # choice's stream (vLLM's behavior)
+                    chunk(i, echo_text, None)
+            last_progress = time.monotonic()
+            while any(s["finish"] is None for s in states):
+                progressed = False
+                for i, s in enumerate(states):
+                    if s["finish"] is not None:
+                        continue
+                    # single stream: block hard (the pre-r4 behavior);
+                    # multi: short per-choice slices so one slow sibling
+                    # never starves the others' deltas
+                    progressed |= drain(i, 0.05 if multi else 600.0)
+                if progressed:
+                    last_progress = time.monotonic()
+                elif time.monotonic() - last_progress > 600.0:
+                    raise TimeoutError("no stream progress in 600s")
             if include_usage:
-                n_gen = len(req.generated)
+                n_gen = sum(len(s["req"].generated) for s in states)
                 raw_write(("data: " + json.dumps({
                     "id": rid, "object": obj, "created": _now(),
                     "model": st.model_name, "choices": [],
@@ -614,12 +648,14 @@ class Handler(BaseHTTPRequestHandler):
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
-            st.engine.cancel(req)
+            for s in states:
+                st.engine.cancel(s["req"])
         except Exception:
             # headers already sent: can't switch to a JSON error response now;
-            # free the slot and drop the connection.
+            # free the slots and drop the connection.
             log.exception("stream failed mid-flight")
-            st.engine.cancel(req)
+            for s in states:
+                st.engine.cancel(s["req"])
             raise BrokenPipeError  # handled (ignored) by do_POST
 
 

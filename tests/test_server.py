@@ -459,3 +459,57 @@ def test_stream_options_requires_stream(server):
               {"model": MODEL_NAME, "prompt": "a",
                "stream_options": {"include_usage": True}})
     assert ei.value.code == 400
+
+
+def test_streaming_n_choices(server):
+    """n > 1 with stream=true (previously 400; vLLM supports it): chunks
+    carry per-choice "index", every choice gets content and a finish chunk,
+    one [DONE] ends the stream."""
+    req = urllib.request.Request(
+        server + "/v1/completions",
+        data=json.dumps({"model": MODEL_NAME, "prompt": "abc",
+                         "max_tokens": 4, "n": 2, "stream": True,
+                         "temperature": 0.8, "seed": 5}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        raw = r.read().decode()
+    events = [ln[len("data: "):] for ln in raw.splitlines()
+              if ln.startswith("data: ")]
+    assert events[-1] == "[DONE]" and events.count("[DONE]") == 1
+    chunks = [json.loads(e) for e in events[:-1]]
+    by_idx = {}
+    for c in chunks:
+        for ch in c["choices"]:
+            by_idx.setdefault(ch["index"], []).append(ch)
+    assert set(by_idx) == {0, 1}
+    for idx, chs in by_idx.items():
+        text = "".join(ch.get("text", "") for ch in chs)
+        assert len(text) >= 1, f"choice {idx} streamed no text"
+        assert chs[-1]["finish_reason"] in ("stop", "length")
+
+
+def test_streaming_echo(server):
+    """echo with stream=true (previously 400): the prompt leads the
+    choice's stream."""
+    req = urllib.request.Request(
+        server + "/v1/completions",
+        data=json.dumps({"model": MODEL_NAME, "prompt": "hello world",
+                         "max_tokens": 3, "echo": True,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        raw = r.read().decode()
+    events = [json.loads(ln[len("data: "):]) for ln in raw.splitlines()
+              if ln.startswith("data: ") and not ln.endswith("[DONE]")]
+    text = "".join(e["choices"][0].get("text", "") for e in events
+                   if e["choices"])
+    assert text.startswith("hello world")
+    assert len(text) > len("hello world"), "no generated text followed echo"
+
+
+def test_streaming_best_of_gt_n_still_rejected(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/completions",
+              {"model": MODEL_NAME, "prompt": "a", "stream": True,
+               "n": 1, "best_of": 3})
+    assert ei.value.code == 400
