@@ -359,8 +359,8 @@ class Handler(BaseHTTPRequestHandler):
         # OpenAI logprobs: completions take an int ``logprobs`` (0 = chosen-
         # token only — still enabled; absent/null = off); chat takes
         # ``logprobs: true`` + ``top_logprobs: N`` (explicit 0 respected).
-        # Capped at the engine's static LOGPROB_K; streaming responses don't
-        # carry logprobs (the non-stream path does — vLLM-compatible subset).
+        # Capped at the engine's static LOGPROB_K; streaming responses carry
+        # per-token logprob chunks (vLLM's streamed-logprobs shape).
         from aws_k8s_ansible_provisioner_tpu.serving.engine import LOGPROB_K
         try:
             if chat:
@@ -380,9 +380,6 @@ class Handler(BaseHTTPRequestHandler):
             return self._error(400, "'logprobs' must be numeric")
         if lp_n is not None and (lp_n < 0 or lp_n > LOGPROB_K):
             return self._error(400, f"logprobs must be in [0, {LOGPROB_K}]")
-        if stream and lp_n is not None:
-            return self._error(400, "logprobs with stream=true is not "
-                                    "supported")
         # OpenAI ``logit_bias``: {token_id: bias} map, additive on logits
         # before every sampling decision (±100 act as force/ban). vLLM
         # behind the reference's gateway accepts it; BIAS_K caps entries.
@@ -452,7 +449,8 @@ class Handler(BaseHTTPRequestHandler):
             self._stream_response(reqs, rid, chat, stops,
                                   n_prompt=len(prompt_ids),
                                   include_usage=include_usage,
-                                  echo_text=prompt_text if echo else None)
+                                  echo_text=prompt_text if echo else None,
+                                  lp_k=lp_n)
         else:
             self._full_response(reqs, rid, chat, stops, len(prompt_ids),
                                 n_choices=n_choices,
@@ -531,7 +529,8 @@ class Handler(BaseHTTPRequestHandler):
 
     def _stream_response(self, reqs, rid: str, chat: bool, stops: List[str],
                          n_prompt: int = 0, include_usage: bool = False,
-                         echo_text: Optional[str] = None):
+                         echo_text: Optional[str] = None,
+                         lp_k: Optional[int] = None):
         """SSE streaming with incremental detokenization (n choices).
 
         Correctness over eagerness: text is held back while it could still be
@@ -557,7 +556,8 @@ class Handler(BaseHTTPRequestHandler):
         obj = "chat.completion.chunk" if chat else "text_completion"
 
         def chunk(idx: int, delta_text: Optional[str],
-                  finish_reason: Optional[str], role: bool = False):
+                  finish_reason: Optional[str], role: bool = False,
+                  lp: Optional[dict] = None):
             payload = {"index": idx, "finish_reason": finish_reason}
             if chat:
                 d = {}
@@ -568,6 +568,8 @@ class Handler(BaseHTTPRequestHandler):
                 payload["delta"] = d
             else:
                 payload["text"] = delta_text or ""
+            if lp is not None:
+                payload["logprobs"] = lp
             body = {"id": rid, "object": obj, "created": _now(),
                     "model": st.model_name, "choices": [payload]}
             if include_usage:
@@ -582,9 +584,33 @@ class Handler(BaseHTTPRequestHandler):
         # detokenizes, stop-string-holds, and finishes independently, tagged
         # by its chunk "index" (the OpenAI multi-choice stream shape).
         hold = max((len(s) for s in stops if s), default=1) - 1
+        base_off = len(echo_text) if echo_text else 0
         states = [{"req": r, "detok": IncrementalDetokenizer(st.tokenizer),
-                   "pending": "", "finish": None} for r in reqs]
+                   "pending": "", "finish": None, "n_lp": 0,
+                   "acc": "", "offset": base_off} for r in reqs]
         multi = len(states) > 1
+
+        def token_lp(s, token: int, delta: str):
+            """Per-token logprob payload for a streamed chunk — the vLLM
+            shape: completions carry parallel one-element arrays, chat a
+            one-element content list. logprob_data[k] is guaranteed present
+            before the k-th token reaches the queue (engine._emit order)."""
+            d = s["req"].logprob_data[s["n_lp"]] \
+                if s["n_lp"] < len(s["req"].logprob_data) else None
+            s["n_lp"] += 1
+            tok_str = st.tokenizer.decode([token])
+            own = None if d is None else d[0]
+            tops = [] if d is None else \
+                [(st.tokenizer.decode([tid]), v) for tid, v in d[1][:lp_k]]
+            if chat:
+                return {"content": [{
+                    "token": tok_str, "logprob": own,
+                    "top_logprobs": [{"token": t, "logprob": v}
+                                     for t, v in tops]}]}
+            off = s["offset"]
+            s["offset"] += len(delta)
+            return {"tokens": [tok_str], "token_logprobs": [own],
+                    "top_logprobs": [dict(tops)], "text_offset": [off]}
 
         def drain(i: int, block_s: float) -> bool:
             """Advance choice i by at most one queue item; emit any ready
@@ -594,6 +620,34 @@ class Handler(BaseHTTPRequestHandler):
                 item = s["req"].out_queue.get(timeout=block_s)
             except queue.Empty:
                 return False
+            if lp_k is not None:
+                # Per-TOKEN chunks so the logprob arrays align with their
+                # token: each queue item emits one chunk carrying that
+                # token's text delta (possibly "" while a multi-byte
+                # sequence is incomplete) and its logprob record. Stop
+                # strings cut the accumulated text without holdback (the
+                # already-sent token entries stand — vLLM's streamed
+                # behavior has the same artifact).
+                if item is None:
+                    tail = s["detok"].finish()
+                    s["finish"] = s["req"].finish_reason or "stop"
+                    if tail:
+                        chunk(i, tail, None)
+                    chunk(i, None, s["finish"])
+                    return True
+                delta = s["detok"].push(item)
+                s["acc"] += delta
+                cut = _apply_stop_strings(s["acc"], stops)
+                if cut is not None:
+                    overshoot = len(s["acc"]) - len(cut)
+                    delta = delta[:len(delta) - overshoot] \
+                        if overshoot <= len(delta) else ""
+                    s["finish"] = "stop"
+                    st.engine.cancel(s["req"])
+                chunk(i, delta, None, lp=token_lp(s, item, delta))
+                if s["finish"]:
+                    chunk(i, None, s["finish"])
+                return True
             if item is None:
                 s["pending"] += s["detok"].finish()
                 s["finish"] = s["req"].finish_reason or "stop"

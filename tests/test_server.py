@@ -513,3 +513,51 @@ def test_streaming_best_of_gt_n_still_rejected(server):
               {"model": MODEL_NAME, "prompt": "a", "stream": True,
                "n": 1, "best_of": 3})
     assert ei.value.code == 400
+
+
+def test_streaming_logprobs_completions(server):
+    """logprobs with stream=true (previously 400; vLLM streams them):
+    per-token chunks carry aligned one-element logprob arrays; entry count
+    matches the completion token count."""
+    req = urllib.request.Request(
+        server + "/v1/completions",
+        data=json.dumps({"model": MODEL_NAME, "prompt": "abc",
+                         "max_tokens": 5, "stream": True,
+                         "logprobs": 2}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        raw = r.read().decode()
+    chunks = [json.loads(ln[len("data: "):]) for ln in raw.splitlines()
+              if ln.startswith("data: ") and not ln.endswith("[DONE]")]
+    lp_chunks = [c for c in chunks
+                 if c["choices"] and c["choices"][0].get("logprobs")]
+    assert len(lp_chunks) == 5, f"expected 5 per-token chunks, {len(lp_chunks)}"
+    offsets = []
+    for c in lp_chunks:
+        lp = c["choices"][0]["logprobs"]
+        assert len(lp["tokens"]) == len(lp["token_logprobs"]) == 1
+        assert isinstance(lp["token_logprobs"][0], float)
+        assert len(lp["top_logprobs"][0]) <= 2
+        offsets.extend(lp["text_offset"])
+    assert offsets == sorted(offsets), "text offsets must be monotone"
+
+
+def test_streaming_logprobs_chat(server):
+    req = urllib.request.Request(
+        server + "/v1/chat/completions",
+        data=json.dumps({"model": MODEL_NAME,
+                         "messages": [{"role": "user", "content": "hi"}],
+                         "max_tokens": 4, "stream": True,
+                         "logprobs": True, "top_logprobs": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        raw = r.read().decode()
+    chunks = [json.loads(ln[len("data: "):]) for ln in raw.splitlines()
+              if ln.startswith("data: ") and not ln.endswith("[DONE]")]
+    entries = [e for c in chunks for ch in c["choices"]
+               if ch.get("logprobs")
+               for e in ch["logprobs"]["content"]]
+    assert len(entries) == 4
+    for e in entries:
+        assert isinstance(e["logprob"], float)
+        assert len(e["top_logprobs"]) <= 1
