@@ -142,11 +142,8 @@ def test_logprobs_validation(server):
               {"model": "tiny-qwen3", "prompt": "x", "max_tokens": 2,
                "logprobs": 99})
     assert e.value.code == 400
-    with pytest.raises(urllib.error.HTTPError) as e:
-        _post(server + "/v1/completions",
-              {"model": "tiny-qwen3", "prompt": "x", "max_tokens": 2,
-               "logprobs": 2, "stream": True})
-    assert e.value.code == 400
+    # logprobs + stream is SUPPORTED since r4 (per-token chunks) — covered
+    # by tests/test_server.py::test_streaming_logprobs_completions
 
 
 def test_completions_logprobs_zero_chosen_only(server):
