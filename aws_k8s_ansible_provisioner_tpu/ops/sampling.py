@@ -36,16 +36,32 @@ def per_slot_keys(seeds: jnp.ndarray, ctrs: jnp.ndarray) -> jax.Array:
 
 def apply_penalties(logits: jnp.ndarray, counts: jnp.ndarray,
                     presence: jnp.ndarray,
-                    frequency: jnp.ndarray) -> jnp.ndarray:
-    """OpenAI presence/frequency penalties over GENERATED-token counts.
+                    frequency: jnp.ndarray,
+                    repetition: jnp.ndarray = None,
+                    prompt_mask: jnp.ndarray = None) -> jnp.ndarray:
+    """OpenAI presence/frequency penalties + vLLM/HF ``repetition_penalty``.
 
     logits: [B, V]; counts: [B, V] int (occurrences of each token in the
     slot's generated text so far); presence/frequency: [B]. Subtractive on
     raw logits before any sampling — the vLLM semantics (greedy decode is
     affected too). Zero penalties are exact no-ops.
+
+    ``repetition`` [B] (1.0 = off) is MULTIPLICATIVE over every token seen
+    in the PROMPT (``prompt_mask`` [B, V] bool) or generated so far — HF
+    ``RepetitionPenaltyLogitsProcessor`` semantics: positive logits divide
+    by the penalty, non-positive multiply. Applied before the subtractive
+    penalties, matching vLLM's sampler order.
     """
     c = counts.astype(jnp.float32)
-    return (logits.astype(jnp.float32)
+    out = logits.astype(jnp.float32)
+    if repetition is not None:
+        seen = c > 0
+        if prompt_mask is not None:
+            seen = seen | prompt_mask
+        r = repetition[:, None].astype(jnp.float32)
+        penalized = jnp.where(out > 0, out / r, out * r)
+        out = jnp.where(seen, penalized, out)
+    return (out
             - frequency[:, None] * c
             - presence[:, None] * (c > 0))
 

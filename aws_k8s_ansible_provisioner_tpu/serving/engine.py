@@ -93,6 +93,10 @@ class Request:
     # tokens (0.0 = off; subtractive on logits — ops/sampling.apply_penalties)
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    # vLLM/HF ``repetition_penalty`` (1.0 = off): multiplicative over every
+    # token in the prompt OR generated so far — positive logits divide,
+    # non-positive multiply (HF RepetitionPenaltyLogitsProcessor semantics).
+    repetition_penalty: float = 1.0
     ignore_eos: bool = False
     stream: bool = False
     cancelled: bool = False
@@ -222,6 +226,13 @@ def _reset_count_row(counts, slot, token):
         counts, jnp.zeros((1, counts.shape[1]), counts.dtype),
         (slot, jnp.int32(0)))
     return counts.at[slot, token].add(1)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _set_mask_row(mask, slot, row):
+    """Overwrite one slot's prompt-token presence row (repetition_penalty
+    covers prompt tokens; set at activation, stale rows no-op at rep=1)."""
+    return jax.lax.dynamic_update_slice(mask, row[None], (slot, jnp.int32(0)))
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -366,6 +377,7 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
                  lengths, rng, temperature, top_k, top_p, mesh=None,
                  impl: str = "auto", logprobs: bool = False,
                  counts=None, presence=None, frequency=None,
+                 repetition=None, prompt_mask=None,
                  penalties: bool = False, table=None, seeds=None,
                  ban_ids=None, ban_until=None, bias_ids=None,
                  bias_vals=None):
@@ -402,11 +414,12 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
                                             positions, cache, attend)
         step_logits = logits[:, 0, :]
         if penalties:
-            # presence/frequency over the [B, V] generated-token counts that
-            # ride the carry (updated per sampled token, so a mid-horizon
-            # repeat is penalized immediately, not at the next dispatch)
+            # presence/frequency/repetition over the [B, V] generated-token
+            # counts that ride the carry (updated per sampled token, so a
+            # mid-horizon repeat is penalized immediately, not at the next
+            # dispatch); repetition additionally covers the prompt mask
             step_logits = apply_penalties(step_logits, cnts, presence,
-                                          frequency)
+                                          frequency, repetition, prompt_mask)
         # OpenAI logit_bias: additive on logits before every sampling
         # decision, then min_tokens stop suppression (mask wins: a +100 bias
         # on eos must not resurrect a banned stop token). The ban evaluates
@@ -759,10 +772,16 @@ class Engine:
         self._bias_n = np.zeros(self.num_slots, np.int32)
         self.pres_pens = np.zeros(self.num_slots, np.float32)
         self.freq_pens = np.zeros(self.num_slots, np.float32)
+        self.rep_pens = np.ones(self.num_slots, np.float32)
         # [num_slots, V] generated-token counts, allocated lazily on the
         # first penalized request (78 MB at Qwen3 vocab x 128 slots — only
         # paid when the feature is used); rides decode_steps' donated carry.
         self.counts = None
+        # [num_slots, V] bool prompt-token presence, lazily allocated with
+        # the first repetition_penalty request (repetition covers PROMPT
+        # tokens too — counts track generated only). Stale rows under
+        # rep == 1.0 slots are exact no-ops, like stale counts rows.
+        self.prompt_mask = None
         self.slot_req: List[Optional[Request]] = [None] * self.num_slots
         # Admission queue + slot lifecycle live in the runtime core (native
         # C++ when built — see native/runtime; Python fallback otherwise).
@@ -1062,6 +1081,7 @@ class Engine:
         self.temps[slot] = 0.0
         self.pres_pens[slot] = 0.0
         self.freq_pens[slot] = 0.0
+        self.rep_pens[slot] = 1.0
         self.ban_until[slot] = 0
         self.bias_ids[slot, :] = 2**31 - 1
         self.bias_vals[slot, :] = 0.0
@@ -1369,13 +1389,32 @@ class Engine:
         self._fill_sampling_rows(req, slot)
         self.pres_pens[slot] = req.presence_penalty
         self.freq_pens[slot] = req.frequency_penalty
-        if req.presence_penalty or req.frequency_penalty:
+        self.rep_pens[slot] = req.repetition_penalty or 1.0
+        if req.repetition_penalty and req.repetition_penalty != 1.0:
+            if self.prompt_mask is None:
+                self.prompt_mask = jnp.zeros(
+                    (self.num_slots, self.cfg.vocab_size), jnp.bool_)
+            row = np.zeros(self.cfg.vocab_size, bool)
+            row[np.asarray(req.prompt_ids, np.int64)] = True
+            self.prompt_mask = _set_mask_row(self.prompt_mask,
+                                             jnp.int32(slot),
+                                             jnp.asarray(row))
+        if (req.presence_penalty or req.frequency_penalty
+                or (req.repetition_penalty
+                    and req.repetition_penalty != 1.0)):
             # Only penalized occupants touch the counts array: a stale row
             # under a zero-penalty occupant is multiplied by zero, so
             # un-penalized prefills never pay this extra device dispatch.
             if self.counts is None:
                 self.counts = jnp.zeros(
                     (self.num_slots, self.cfg.vocab_size), jnp.int32)
+            if self.prompt_mask is None:
+                # allocated WITH counts (not only for repetition requests):
+                # the penalized decode program's signature always carries
+                # the mask, so pres/freq-only traffic reuses the program
+                # warmup compiled instead of compiling a mask-less variant
+                self.prompt_mask = jnp.zeros(
+                    (self.num_slots, self.cfg.vocab_size), jnp.bool_)
             if resumed:
                 # restore the full pre-preemption penalty state (the
                 # discarded prefill token contributes nothing)
@@ -1641,7 +1680,8 @@ class Engine:
         req = self.slot_req[slot]
         return (req.logprobs is not None
                 or (self.counts is not None
-                    and bool(self.pres_pens[slot] or self.freq_pens[slot]))
+                    and bool(self.pres_pens[slot] or self.freq_pens[slot]
+                             or self.rep_pens[slot] != 1.0))
                 or self.ban_until[slot] > self.lengths[slot]
                 or self._bias_n[slot] > 0)
 
@@ -1752,7 +1792,8 @@ class Engine:
         self._spec_plain_due = False
         want_lp = self._want_logprobs(self.slot_req)
         want_pen = self.counts is not None and bool(
-            self.pres_pens.any() or self.freq_pens.any())
+            self.pres_pens.any() or self.freq_pens.any()
+            or (self.rep_pens != 1.0).any())
         real_counts = self.counts
         self.cache, new_counts, out = decode_steps(
             self.cfg, horizon, self.params, self.cache,
@@ -1764,6 +1805,8 @@ class Engine:
             counts=self.counts if want_pen else None,
             presence=jnp.asarray(self.pres_pens) if want_pen else None,
             frequency=jnp.asarray(self.freq_pens) if want_pen else None,
+            repetition=jnp.asarray(self.rep_pens) if want_pen else None,
+            prompt_mask=self.prompt_mask if want_pen else None,
             penalties=want_pen,
             table=jnp.asarray(self.table) if self.paged else None,
             seeds=jnp.asarray(self.seeds),
@@ -1853,6 +1896,7 @@ class Engine:
         self.temps[slot] = 0.0
         self.pres_pens[slot] = 0.0
         self.freq_pens[slot] = 0.0
+        self.rep_pens[slot] = 1.0
         self.ban_until[slot] = 0
         self.bias_ids[slot, :] = 2**31 - 1
         self.bias_vals[slot, :] = 0.0
@@ -2063,6 +2107,7 @@ class Engine:
         # their counts input, so the scratch buffer is freed on return.
         cnts = jnp.zeros((self.num_slots, self.cfg.vocab_size), jnp.int32)
         cnts = _reset_count_row(cnts, jnp.int32(0), jnp.int32(0))
+        mask = jnp.zeros((self.num_slots, self.cfg.vocab_size), jnp.bool_)
         self.cache, _, _ = decode_steps(
             self.cfg, horizon, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
@@ -2070,14 +2115,16 @@ class Engine:
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
             mesh=self.mesh, impl=self.serving.attention_impl,
             counts=cnts, presence=jnp.asarray(self.pres_pens),
-            frequency=jnp.asarray(self.freq_pens), penalties=True,
+            frequency=jnp.asarray(self.freq_pens),
+            repetition=jnp.asarray(self.rep_pens), prompt_mask=mask,
+            penalties=True,
             table=jnp.asarray(self.table) if self.paged else None,
             seeds=jnp.asarray(self.seeds),
             ban_ids=jnp.asarray(self.ban_ids),
             ban_until=jnp.asarray(self.ban_until),
             bias_ids=jnp.asarray(self.bias_ids),
             bias_vals=jnp.asarray(self.bias_vals))
-        del cnts
+        del cnts, mask
         # Logprobs program variants ('logprobs' is a static arg on every step
         # fn — distinct programs): one isolated request compiles the
         # single-prefill + fused-decode logprob programs, one burst compiles

@@ -285,11 +285,15 @@ class Handler(BaseHTTPRequestHandler):
             top_k = int(body.get("top_k", 0))
             presence_penalty = float(body.get("presence_penalty", 0.0))
             frequency_penalty = float(body.get("frequency_penalty", 0.0))
+            repetition_penalty = float(body.get("repetition_penalty", 1.0))
         except (TypeError, ValueError):
             return self._error(400, "sampling parameters must be numeric")
         if not (-2.0 <= presence_penalty <= 2.0
                 and -2.0 <= frequency_penalty <= 2.0):
             return self._error(400, "penalties must be in [-2, 2]")
+        if not (0.0 < repetition_penalty <= 10.0):
+            return self._error(400, "'repetition_penalty' must be in "
+                                    "(0, 10]")
         if max_tokens < 1 or max_tokens > st.engine.max_len:
             return self._error(400, f"max_tokens must be in [1, "
                                     f"{st.engine.max_len}]")
@@ -430,6 +434,7 @@ class Handler(BaseHTTPRequestHandler):
                 top_k=top_k, top_p=top_p, stream=stream, logprobs=eng_lp,
                 presence_penalty=presence_penalty,
                 frequency_penalty=frequency_penalty,
+                repetition_penalty=repetition_penalty,
                 stop_token_ids=stop_token_ids, min_tokens=min_tokens,
                 logit_bias=logit_bias,
                 seed=None if seed is None else seed + i)
