@@ -179,6 +179,27 @@ def _apply_logit_bias(logits: jnp.ndarray, bias_ids, bias_vals) -> jnp.ndarray:
         bias_vals.astype(logits.dtype), mode="drop")
 
 
+def _apply_prefill_repetition(logits: jnp.ndarray, tokens, true_lens,
+                              rep) -> jnp.ndarray:
+    """repetition_penalty for the PREFILL-sampled first token: the seen-set
+    is the prompt itself (tokens [N, T] with true_lens [N] masking the right
+    padding). Always-on (no program variant): rep == 1.0 divides/multiplies
+    by exactly 1.0, an exact no-op — same design as the ban/bias rows.
+    Without this the first generated token escaped the penalty (review r4),
+    diverging from HF/vLLM, whose processors see the prompt from token 0."""
+    if rep is None:
+        return logits
+    N, V = logits.shape
+    T = tokens.shape[1]
+    cols = jnp.arange(T, dtype=jnp.int32)[None, :]
+    ids = jnp.where(cols < true_lens[:, None], tokens, jnp.int32(2**31 - 1))
+    seen = jnp.zeros((N, V), jnp.bool_)
+    seen = seen.at[jnp.arange(N)[:, None], ids].set(True, mode="drop")
+    r = rep[:, None].astype(jnp.float32)
+    out = logits.astype(jnp.float32)
+    return jnp.where(seen, jnp.where(out > 0, out / r, out * r), out)
+
+
 def _mask_banned(logits: jnp.ndarray, ban_ids, ban_until, lens) -> jnp.ndarray:
     """vLLM ``min_tokens`` semantics: while a slot's context length is below
     ``ban_until`` (prompt_len + min_tokens), its stop tokens are masked to
@@ -251,7 +272,7 @@ def _restore_count_row(counts, slot, row):
 def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
                  temperature, top_k, top_p, logprobs: bool = False,
                  pages=None, seed=None, ban_ids=None, ban_until=None,
-                 bias_ids=None, bias_vals=None):
+                 bias_ids=None, bias_vals=None, rep=None):
     """Prefill one prompt into one slot; returns (cache, first sampled token).
 
     tokens: [1, T] right-padded to a bucket; true_len: scalar valid length;
@@ -269,6 +290,8 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
                                      window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = jnp.take(logits[0], true_len - 1, axis=0)[None]   # [1, V]
+    last = _apply_prefill_repetition(last, tokens, true_len[None],
+                                     rep[None] if rep is not None else None)
     if bias_ids is not None:
         last = _apply_logit_bias(last, bias_ids[None], bias_vals[None])
     if ban_ids is not None:
@@ -292,7 +315,7 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
                        slots, rng, temperature, top_k, top_p,
                        logprobs: bool = False, tables=None, seeds=None,
                        ban_ids=None, ban_until=None,
-                       bias_ids=None, bias_vals=None):
+                       bias_ids=None, bias_vals=None, reps=None):
     """Prefill N prompts into N slots in ONE dispatch.
 
     tokens: [N, T] right-padded to a (row, length) bucket; true_lens/slots/
@@ -313,6 +336,7 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
                                            window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = logits[jnp.arange(N), true_lens - 1]            # [N, V]
+    last = _apply_prefill_repetition(last, tokens, true_lens, reps)
     if bias_ids is not None:
         last = _apply_logit_bias(last, bias_ids, bias_vals)
     if ban_ids is not None:
@@ -330,7 +354,8 @@ def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
                        chunk_len, rng, temperature, top_k, top_p,
                        logprobs: bool = False, pages=None, seed=None,
                        ban_ids=None, ban_until=None,
-                       bias_ids=None, bias_vals=None):
+                       bias_ids=None, bias_vals=None, rep=None,
+                       rep_seen=None):
     """Prefill ONE chunk of a long prompt; decode interleaves between chunks.
 
     tokens: [1, C] (the chunk, right-padded on the final chunk); start: row
@@ -351,6 +376,13 @@ def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
                                            window=cfg.sliding_window)
     logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
     last = jnp.take(logits[0], chunk_len - 1, axis=0)[None]  # [1, V]
+    if rep is not None and rep_seen is not None:
+        # chunks only carry a slice of the prompt: the seen-set over the
+        # WHOLE context comes precomputed from the host ([V] bool)
+        r = rep.astype(jnp.float32)
+        lf = last.astype(jnp.float32)
+        last = jnp.where(rep_seen[None],
+                         jnp.where(lf > 0, lf / r, lf * r), lf)
     if bias_ids is not None:
         last = _apply_logit_bias(last, bias_ids[None], bias_vals[None])
     if ban_ids is not None:
@@ -1454,7 +1486,8 @@ class Engine:
             ban_ids=jnp.asarray(self.ban_ids[slot]),
             ban_until=jnp.int32(self.ban_until[slot]),
             bias_ids=jnp.asarray(self.bias_ids[slot]),
-            bias_vals=jnp.asarray(self.bias_vals[slot]))
+            bias_vals=jnp.asarray(self.bias_vals[slot]),
+            rep=jnp.float32(req.repetition_penalty or 1.0))
         lp = None
         if req.logprobs is not None:
             self.cache, token, lp_t = out
@@ -1504,12 +1537,14 @@ class Engine:
         ban_until = np.zeros(n_bucket, np.int32)
         bias_ids = np.full((n_bucket, BIAS_K), 2**31 - 1, np.int32)
         bias_vals = np.zeros((n_bucket, BIAS_K), np.float32)
+        reps = np.ones(n_bucket, np.float32)
         for i, (req, slot) in enumerate(batch):
             self._fill_sampling_rows(req, slot)
             ban_ids[i] = self.ban_ids[slot]
             ban_until[i] = self.ban_until[slot]
             bias_ids[i] = self.bias_ids[slot]
             bias_vals[i] = self.bias_vals[slot]
+            reps[i] = req.repetition_penalty or 1.0
         t0 = time.monotonic()
         want_lp = self._want_logprobs([r for r, _ in batch])
         out = prefill_batch_step(
@@ -1518,7 +1553,8 @@ class Engine:
             jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
             logprobs=want_lp, tables=tables, seeds=jnp.asarray(seeds),
             ban_ids=jnp.asarray(ban_ids), ban_until=jnp.asarray(ban_until),
-            bias_ids=jnp.asarray(bias_ids), bias_vals=jnp.asarray(bias_vals))
+            bias_ids=jnp.asarray(bias_ids), bias_vals=jnp.asarray(bias_vals),
+            reps=jnp.asarray(reps))
         lp_t = None
         if want_lp:
             self.cache, toks, lp_t = out
@@ -1543,13 +1579,21 @@ class Engine:
         prompt + generated for a preemption resume.
         """
         self._fill_sampling_rows(req, slot)   # before the first chunk dispatch
+        # repetition_penalty seen-set over the WHOLE context the chunk walk
+        # will have written (chunk dispatches only see their slice) — only
+        # the final chunk's sample survives, and it must be penalized over
+        # all of it (review r4: the first token escaped the penalty)
+        rep_seen = np.zeros(self.cfg.vocab_size, bool)
+        ids_all = (pref[1] if self.paged and pref is not None
+                   else list(req.prompt_ids))
+        rep_seen[np.asarray(ids_all, np.int64)] = True
         if self.paged:
             _, ids, off, resumed = pref if pref is not None \
                 else ("paged", list(req.prompt_ids), 0, False)
             self.lengths[slot] = off
             self._chunk = {"req": req, "slot": slot, "off": off,
                            "C": self._chunk_size, "ids": ids,
-                           "resumed": resumed}
+                           "resumed": resumed, "rep_seen": rep_seen}
             return
         self._slot_tokens[slot] = ()   # rows about to be overwritten
         off = 0
@@ -1568,7 +1612,7 @@ class Engine:
             self.metrics.prefix_tokens_reused.inc(n)
         self.lengths[slot] = off
         self._chunk = {"req": req, "slot": slot, "off": off,
-                       "C": self._chunk_size}
+                       "C": self._chunk_size, "rep_seen": rep_seen}
 
     def _advance_chunk(self):
         """Dispatch the next chunk of the in-progress chunked prefill."""
@@ -1605,7 +1649,9 @@ class Engine:
                 ban_ids=jnp.asarray(self.ban_ids[slot]),
                 ban_until=jnp.int32(self.ban_until[slot]),
                 bias_ids=jnp.asarray(self.bias_ids[slot]),
-                bias_vals=jnp.asarray(self.bias_vals[slot]))
+                bias_vals=jnp.asarray(self.bias_vals[slot]),
+                rep=jnp.float32(req.repetition_penalty or 1.0),
+                rep_seen=jnp.asarray(st["rep_seen"]))
             if req.logprobs is not None and not st.get("resumed") \
                     and off + len(chunk) >= len(ids):
                 self.cache, token, lp_t = out

@@ -641,10 +641,16 @@ class Handler(BaseHTTPRequestHandler):
                     chunk(i, None, s["finish"])
                     return True
                 delta = s["detok"].push(item)
+                # windowed stop scan: only the region a NEW stop match could
+                # end in (delta + the longest stop's tail) — scanning the
+                # whole accumulated text would be O(len^2) per stream
+                # (review r4). Matches wholly inside older text were caught
+                # on earlier tokens.
+                window = (s["acc"][-hold:] if hold else "") + delta
                 s["acc"] += delta
-                cut = _apply_stop_strings(s["acc"], stops)
+                cut = _apply_stop_strings(window, stops)
                 if cut is not None:
-                    overshoot = len(s["acc"]) - len(cut)
+                    overshoot = len(window) - len(cut)
                     delta = delta[:len(delta) - overshoot] \
                         if overshoot <= len(delta) else ""
                     s["finish"] = "stop"
@@ -686,12 +692,22 @@ class Handler(BaseHTTPRequestHandler):
                 for i, s in enumerate(states):
                     if s["finish"] is not None:
                         continue
-                    # single stream: block hard (the pre-r4 behavior);
-                    # multi: short per-choice slices so one slow sibling
-                    # never starves the others' deltas
-                    progressed |= drain(i, 0.05 if multi else 600.0)
+                    if multi:
+                        # drain every available item without blocking — a
+                        # per-choice blocking slice would cap a fast
+                        # choice's delta rate at one token per idle-sibling
+                        # timeout (review r4); the single sleep below is
+                        # the only wait when ALL queues are empty
+                        while s["finish"] is None and drain(i, 0.0):
+                            progressed = True
+                    else:
+                        progressed |= drain(i, 600.0)
                 if progressed:
                     last_progress = time.monotonic()
+                elif multi:
+                    if time.monotonic() - last_progress > 600.0:
+                        raise TimeoutError("no stream progress in 600s")
+                    time.sleep(0.01)
                 elif time.monotonic() - last_progress > 600.0:
                     raise TimeoutError("no stream progress in 600s")
             if include_usage:

@@ -140,10 +140,11 @@ def test_repetition_penalty_changes_stream_and_off_is_noop():
     assert plain == run(1.0)            # rp=1.0 exact no-op, deterministic
     strong = run(5.0)
     assert strong != plain              # penalty actually steers the stream
-    # prompt tokens are penalized too. The FIRST token comes from prefill,
-    # which applies no penalties (the documented pres/freq behavior) — the
-    # first DECODE token must avoid the repeated prompt tokens and the
-    # prefill token.
+    # prompt tokens are penalized FROM TOKEN 0: the prefill-sampled first
+    # token applies the repetition penalty over the prompt's own tokens
+    # (review r4 — HF/vLLM processors see the prompt from the first draw),
+    # and the first decode token additionally avoids the prefill token.
+    assert strong[0] not in prompt
     assert strong[1] not in prompt + strong[:1]
 
 
