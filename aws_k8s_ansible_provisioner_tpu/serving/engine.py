@@ -576,15 +576,22 @@ class Engine:
             # BEFORE the mesh sharding below, so each chip receives the
             # int8 shard (half the transfer and half the resident bytes).
             from aws_k8s_ansible_provisioner_tpu.models.quant import (
-                quantize_params)
+                quantize_params, weights_quantized)
 
-            # host=True under a mesh: leaf-wise numpy quantization so no
-            # single chip ever holds the full unquantized tree (the jitted
-            # path would device_put it whole — the 8B-on-v5e-8 OOM the
-            # sharded loader exists to avoid)
-            self.params = params = quantize_params(
-                params, cfg,
-                host=mesh is not None or serving.mesh.num_devices > 1)
+            if weights_quantized(params):
+                # Already-quantized tree (e.g. restored from an int8
+                # checkpoint): re-quantizing would treat the int8 kernels as
+                # values and overwrite the scale leaves — silent corruption,
+                # not an error. Skip; sharding handles quantized trees.
+                pass
+            else:
+                # host=True under a mesh: leaf-wise numpy quantization so no
+                # single chip ever holds the full unquantized tree (the
+                # jitted path would device_put it whole — the 8B-on-v5e-8
+                # OOM the sharded loader exists to avoid)
+                self.params = params = quantize_params(
+                    params, cfg,
+                    host=mesh is not None or serving.mesh.num_devices > 1)
         if serving.kv_dtype not in ("auto", "int8"):
             # An unrecognized value (e.g. "fp8", "INT8") must not silently
             # degrade to the unquantized cache — capacity would halve with no
@@ -1146,6 +1153,12 @@ class Engine:
         if len(req.logit_bias) > BIAS_K:
             raise ValueError(f"logit_bias supports at most {BIAS_K} entries "
                              f"(got {len(req.logit_bias)})")
+        if req.repetition_penalty is not None and req.repetition_penalty <= 0:
+            # The where(out>0, out/r, out*r) kernels flip logit signs for
+            # r <= 0 — silently nonsensical sampling for a direct engine
+            # user the HTTP layer's (0, 10] check never sees.
+            raise ValueError(f"repetition_penalty must be > 0 "
+                             f"(got {req.repetition_penalty})")
         budget = self.max_len - len(req.prompt_ids) - 1
         if req.max_tokens > budget:
             req.max_tokens = max(1, budget)

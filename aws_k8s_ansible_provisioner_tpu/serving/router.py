@@ -209,8 +209,13 @@ class BackendPool:
                 if sticky in pool and sticky != order[0]:
                     s = self._score(sticky, now)
                     best = known[0][0] if known else None
-                    if s is None or best is None \
-                            or s <= best + self.load_slack:
+                    # A sticky replica with a stale/missing /load sample is
+                    # only honored when NO replica has a fresh one (cold
+                    # start / poller off): a wedged-but-connectable replica
+                    # must not keep attracting its affinity traffic past the
+                    # load_slack yield (advisor r4).
+                    if (s is None and best is None) or (
+                            s is not None and s <= best + self.load_slack):
                         order.remove(sticky)
                         order.insert(0, sticky)
             return order

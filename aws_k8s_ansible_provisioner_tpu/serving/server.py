@@ -37,6 +37,23 @@ def _now() -> int:
     return int(time.time())
 
 
+class _NotifyQueue(queue.Queue):
+    """Request out_queue that signals a shared Event on every put.
+
+    A multi-choice (n > 1) stream handler can't block on n stdlib queues at
+    once; blocking on this one shared event replaces the ~100 Hz nonblocking
+    poll-and-sleep sweep that burned CPU per concurrent stream (advisor r4).
+    """
+
+    def __init__(self, event: threading.Event):
+        super().__init__()
+        self.event = event
+
+    def put(self, item, *a, **kw):
+        super().put(item, *a, **kw)
+        self.event.set()
+
+
 class ServerState:
     """Everything the handler needs: engine, tokenizer, templater, identity."""
 
@@ -429,6 +446,9 @@ class Handler(BaseHTTPRequestHandler):
             # temperature=0. Each sibling prefills the prompt itself (the
             # prefix cache only consults on ISOLATED arrivals, and the
             # siblings queue together), so n multiplies prefill cost.
+            # Multi-choice streams share one wakeup event across the sibling
+            # out_queues so the handler blocks instead of polling n queues.
+            notify = threading.Event() if (stream and best_of > 1) else None
             reqs = [st.engine.generate(
                 prompt_ids, max_tokens=max_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, stream=stream, logprobs=eng_lp,
@@ -437,7 +457,8 @@ class Handler(BaseHTTPRequestHandler):
                 repetition_penalty=repetition_penalty,
                 stop_token_ids=stop_token_ids, min_tokens=min_tokens,
                 logit_bias=logit_bias,
-                seed=None if seed is None else seed + i)
+                seed=None if seed is None else seed + i,
+                **({"out_queue": _NotifyQueue(notify)} if notify else {}))
                 for i in range(best_of)]
         except ContextLengthExceeded as e:
             # Same wire shape the reference's vLLM returns for an oversized
@@ -707,7 +728,18 @@ class Handler(BaseHTTPRequestHandler):
                 elif multi:
                     if time.monotonic() - last_progress > 600.0:
                         raise TimeoutError("no stream progress in 600s")
-                    time.sleep(0.01)
+                    ev = getattr(states[0]["req"].out_queue, "event", None)
+                    if ev is not None:
+                        # wait → clear → re-drain: a put racing the clear
+                        # leaves its item in the queue for the drain sweep,
+                        # and a put after the clear re-sets the event, so no
+                        # wakeup is ever lost.
+                        ev.wait(timeout=1.0)
+                        ev.clear()
+                    else:
+                        # siblings submitted without the shared event (direct
+                        # callers constructing their own reqs)
+                        time.sleep(0.01)
                 elif time.monotonic() - last_progress > 600.0:
                     raise TimeoutError("no stream progress in 600s")
             if include_usage:

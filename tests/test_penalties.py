@@ -37,6 +37,22 @@ def _run(cfg, params, serving, pen, max_tokens=14):
     return r.generated, eng
 
 
+def test_nonpositive_repetition_penalty_rejected_at_submit():
+    """Engine.submit (not just the HTTP layer) rejects repetition_penalty
+    <= 0: the where(out>0, out/r, out*r) kernels would silently flip logit
+    signs for a direct engine/bench caller (advisor r4)."""
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    serving = ServingConfig(max_decode_slots=1, max_cache_len=64,
+                            prefill_buckets=(16,), dtype="float32",
+                            prefix_cache=False)
+    eng = Engine(cfg, params, serving)
+    for bad in (0.0, -1.0):
+        with pytest.raises(ValueError, match="repetition_penalty"):
+            eng.submit(Request(prompt_ids=[5, 6, 7], max_tokens=4,
+                               repetition_penalty=bad))
+
+
 def test_heavy_penalty_breaks_greedy_loops():
     cfg = tiny_qwen3()
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)

@@ -246,6 +246,26 @@ def test_affinity_yields_when_overloaded():
     assert pool.pick("k1")[0] == "b:1"
 
 
+def test_stale_sticky_not_promoted_over_fresh_replicas():
+    """A sticky replica whose /load sample went stale (wedged-but-connectable
+    poller target) must NOT keep attracting its affinity traffic while other
+    replicas have fresh samples (advisor r4) — but stale-sticky is still
+    honored when NO replica has a fresh sample (cold start)."""
+    import aws_k8s_ansible_provisioner_tpu.serving.router as rt
+
+    pool = _frozen_pool(["a:1", "b:1"])
+    pool.note_affinity("k1", "a:1")
+    pool.note_load("a:1", active=0, queued=0)
+    pool.note_load("b:1", active=1, queued=0)
+    # age a's sample past the TTL: b (fresh) must win despite affinity
+    pool._load["a:1"] = (0, __import__("time").monotonic() - rt.LOAD_TTL_S - 1)
+    for _ in range(3):
+        assert pool.pick("k1")[0] == "b:1"
+    # cold start: no fresh samples anywhere → sticky honored again
+    pool._load.clear()
+    assert pool.pick("k1")[0] == "a:1"
+
+
 def test_affinity_key_from_bodies():
     from aws_k8s_ansible_provisioner_tpu.serving.router import _affinity_key
 

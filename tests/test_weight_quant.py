@@ -109,6 +109,22 @@ def test_quantized_engine_generates_and_is_deterministic():
     assert weights_quantized(eng.params)
 
 
+def test_prequantized_tree_not_requantized():
+    """An already-int8 tree handed to an int8 engine must pass through
+    untouched: re-quantizing would treat the int8 kernels as values and
+    overwrite the scale leaves — silent weight corruption (advisor r4)."""
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    q = quantize_params(params, cfg)
+    serving = ServingConfig(max_decode_slots=4, max_cache_len=64,
+                            prefill_buckets=(16,), dtype="float32",
+                            weights_dtype="int8", prefix_cache=False)
+    prompts = [[3, 5, 7], [11, 2, 9, 4]]
+    from_fp = _run(Engine(cfg, params, serving), prompts)
+    from_q = _run(Engine(cfg, q, serving), prompts)
+    assert from_fp == from_q
+
+
 def test_quantized_under_tp_mesh_token_parity(cpu_devices):
     """Same quantized weights, tp=2-sharded vs single-device: the scale
     leaves shard with their kernels' out axes (parallel/sharding.py) and the
