@@ -646,6 +646,15 @@ class ServingConfig:
     # add dispatches (same-slot reuse, would-chunk-anyway prompts) are
     # always taken. See Engine._hit_pays.
     prefix_cache_payback_rows: int = 256
+    # Paged-mode burst economics: under a burst the batched prefill normally
+    # beats a prefix hit (a hit forces the serialized chunk walk), so
+    # matches are dropped — UNLESS the reusable prefix spans at least this
+    # many whole pages, where skipping the shared-prefix compute (and
+    # sharing the pages instead of duplicating them) outweighs losing the
+    # batch slot. The router's prompt-affinity exists to produce exactly
+    # these long shared prefixes, so this is what makes affinity pay under
+    # concurrent load (ROUTER_BENCH.json measures the hit rate).
+    prefix_reuse_min_pages: int = 2
     # Prompt-lookup speculative decoding (the vLLM feature of the same name):
     # draft the next spec_k tokens by matching the context's trailing
     # spec_ngram against its own history, verify all drafts in ONE forward
@@ -657,6 +666,11 @@ class ServingConfig:
     # dp shards). Wins on repetitive continuations (code, quoting, RAG);
     # costs one extra model-width of FLOPs per step when nothing matches.
     spec_decode: bool = False
+    # Proposal source: "prompt_lookup" (n-gram self-matching, zero extra
+    # model) or "draft" (a small draft LM proposes every step — the vLLM
+    # draft-worker pairing; pass draft=(cfg, params) to Engine). Verify,
+    # eligibility, and mesh gating are shared (serving/draft.py).
+    spec_method: str = "prompt_lookup"
     spec_k: int = 4
     spec_ngram: int = 3
     max_tokens_default: int = 256

@@ -1,0 +1,746 @@
+"""Guided decoding: OpenAI ``response_format`` (json_object / json_schema).
+
+The reference serves this through its delegated vLLM engine (SURVEY.md §2.2
+row 1: the OpenAI surface exercised by ``/root/reference/llm-d-test.yaml``
+includes vLLM's guided-decoding extensions). Our engine owns the sampler, so
+constrained output is implemented natively:
+
+- A **character-level machine** defines the language: either the exact JSON
+  pushdown machine (``json_object`` — arbitrary nesting via an explicit
+  context stack folded into the state, depth-capped so the state space stays
+  finite) or a schema-compiled NFA (``json_schema`` — the schema tree is
+  finite, so Thompson construction + lazy subset stepping never blows up).
+- A **token-level wrapper** (:class:`TokenGrammar`) lifts the char machine to
+  the tokenizer's vocabulary: for a machine state, a token is *allowed* iff
+  walking its bytes does not dead-end (partial progress is fine — the token
+  need not complete the value). Masks are computed lazily per visited state,
+  vectorized over the whole vocab with numpy (grouping by unique state per
+  byte position), packed to uint32 bitmask words, and cached.
+- The engine applies the mask on-device (``engine._apply_allow``) before
+  sampling, exactly like the ban/bias rows, and advances the host-side state
+  with each emitted token. Guided slots force horizon-1 decode dispatches
+  (the host must see token N before it can mask token N+1) — the documented
+  throughput trade of every host-FSM guided decoder; unguided traffic keeps
+  the fused horizon.
+
+EOS policy: the eos bit is set iff the machine is in an accepting state (the
+JSON value is complete), so generation can only stop on valid output; in the
+accepting state whitespace remains allowed so ``min_tokens`` can never wedge
+a slot with an all-banned row.
+
+Schema subset (documented, validated at compile): types object / array /
+string / number / integer / boolean / null, ``enum`` / ``const`` of scalars,
+``anyOf`` / ``oneOf``, type lists, nested to any (finite) schema depth.
+Object properties are emitted **in schema order**; properties listed in
+``required`` (or all, when ``required`` is absent — the OpenAI structured-
+outputs contract) are mandatory, trailing non-required properties become
+optional comma-groups. Unsupported keywords that would silently change
+semantics (``$ref``, ``patternProperties``, ``additionalProperties: {...}``)
+raise ``ValueError`` → HTTP 400.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Character-level machine interface
+# ---------------------------------------------------------------------------
+#
+# A char machine is any object with:
+#   start() -> state            (hashable)
+#   step(state, byte:int) -> state | None
+#   accepting(state) -> bool
+# States are interned by TokenGrammar, so tuples/frozensets are fine.
+
+_WS = frozenset(b" \t\n\r")
+_DIGITS = frozenset(b"0123456789")
+_HEX = frozenset(b"0123456789abcdefABCDEF")
+# String-body bytes: anything >= 0x20 except '"' and '\'. Continuation bytes
+# of multi-byte UTF-8 chars fall in 0x80-0xFF and are allowed — the machine
+# runs on bytes, so it accepts any UTF-8 content like JSON itself does.
+_STR_BODY = frozenset(b for b in range(0x20, 0x100) if b not in (0x22, 0x5C))
+_ESC_ONE = frozenset(b'"\\/bfnrt')
+
+# Modes where a number may implicitly end (next char re-dispatches in parent)
+_NUM_ENDABLE = {"num_zero", "num_int", "num_frac", "num_exp"}
+_NUM_CONT = {
+    "num_zero": frozenset(b".eE"),
+    "num_int": _DIGITS | frozenset(b".eE"),
+    "num_frac": _DIGITS | frozenset(b"eE"),
+    "num_exp": _DIGITS,
+}
+
+
+class JsonMachine:
+    """Exact JSON over bytes: state = (mode, context-stack).
+
+    The stack (tuple of 'O'/'A') makes nesting exact to ``max_depth``; a
+    '{'/'[' beyond the cap rejects, keeping the reachable state space finite
+    so TokenGrammar's caches stay bounded. ``top='object'`` is the OpenAI
+    ``json_object`` contract (top level must be an object); ``top='value'``
+    accepts any JSON value (used for schema-less array/scalar tests).
+    """
+
+    def __init__(self, top: str = "object", max_depth: int = 32):
+        assert top in ("object", "value")
+        self._top = top
+        self._max_depth = max_depth
+
+    def start(self):
+        return ("top", ())
+
+    def accepting(self, st) -> bool:
+        mode, stack = st
+        if mode == "done":
+            return True
+        return not stack and mode in _NUM_ENDABLE and self._top == "value"
+
+    # -- helpers ------------------------------------------------------------
+
+    def _value_done(self, stack):
+        if not stack:
+            return ("done", ())
+        return (("obj_post_val", stack) if stack[-1] == "O"
+                else ("arr_post_val", stack))
+
+    def _dispatch_value(self, stack, c):
+        """Transition for a byte seen where a value may start."""
+        if c == 0x22:                                   # '"'
+            return ("str", stack)
+        if c == 0x7B:                                   # '{'
+            if len(stack) >= self._max_depth:
+                return None
+            return ("obj_open", stack + ("O",))
+        if c == 0x5B:                                   # '['
+            if len(stack) >= self._max_depth:
+                return None
+            return ("arr_open", stack + ("A",))
+        if c == 0x2D:                                   # '-'
+            return ("num_neg", stack)
+        if c == 0x30:                                   # '0'
+            return ("num_zero", stack)
+        if c in _DIGITS:
+            return ("num_int", stack)
+        if c == 0x74:                                   # 't'
+            return (("lit", "true", 1), stack)
+        if c == 0x66:                                   # 'f'
+            return (("lit", "false", 1), stack)
+        if c == 0x6E:                                   # 'n'
+            return (("lit", "null", 1), stack)
+        return None
+
+    # -- the transition function --------------------------------------------
+
+    def step(self, st, c: int):
+        mode, stack = st
+        # number end-and-redispatch: ',' after "12" closes the number first
+        if mode in _NUM_ENDABLE and c not in _NUM_CONT[mode]:
+            return self.step(self._value_done(stack), c)
+
+        if mode == "top":
+            if c in _WS:
+                return st
+            if self._top == "object":
+                return ("obj_open", ("O",)) if c == 0x7B else None
+            return self._dispatch_value(stack, c)
+        if mode == "done":
+            return st if c in _WS else None
+
+        # strings (value and object-key variants share shapes)
+        if mode in ("str", "key"):
+            if c == 0x22:
+                return (self._value_done(stack) if mode == "str"
+                        else ("post_key", stack))
+            if c == 0x5C:
+                return (mode + "_esc", stack)
+            return st if c in _STR_BODY else None
+        if mode in ("str_esc", "key_esc"):
+            base = mode[:-4]
+            if c in _ESC_ONE:
+                return (base, stack)
+            if c == 0x75:                               # 'u'
+                return (base + "_u4", stack)
+            return None
+        if isinstance(mode, str) and mode.endswith(("_u1", "_u2", "_u3",
+                                                    "_u4")):
+            if c not in _HEX:
+                return None
+            base, n = mode.rsplit("_u", 1)
+            left = int(n) - 1
+            return ((base, stack) if left == 0
+                    else (f"{base}_u{left}", stack))
+
+        # numbers
+        if mode == "num_neg":
+            if c == 0x30:
+                return ("num_zero", stack)
+            return ("num_int", stack) if c in _DIGITS else None
+        if mode in _NUM_ENDABLE:                        # continuation chars
+            if c == 0x2E:                               # '.'
+                return ("num_dot", stack)
+            if c in (0x65, 0x45):                       # e E
+                return ("num_e", stack)
+            return (mode, stack) if c in _DIGITS else None
+        if mode == "num_dot":
+            return ("num_frac", stack) if c in _DIGITS else None
+        if mode == "num_e":
+            if c in (0x2B, 0x2D):
+                return ("num_esign", stack)
+            return ("num_exp", stack) if c in _DIGITS else None
+        if mode == "num_esign":
+            return ("num_exp", stack) if c in _DIGITS else None
+
+        # literals true/false/null
+        if isinstance(mode, tuple) and mode[0] == "lit":
+            _, word, i = mode
+            if c != ord(word[i]):
+                return None
+            if i + 1 == len(word):
+                return self._value_done(stack)
+            return (("lit", word, i + 1), stack)
+
+        # objects
+        if mode == "obj_open":
+            if c in _WS:
+                return st
+            if c == 0x22:
+                return ("key", stack)
+            if c == 0x7D:                               # '}'
+                return self._value_done(stack[:-1])
+            return None
+        if mode == "post_key":
+            if c in _WS:
+                return st
+            return ("obj_val_expect", stack) if c == 0x3A else None
+        if mode == "obj_val_expect":
+            if c in _WS:
+                return st
+            return self._dispatch_value(stack, c)
+        if mode == "obj_post_val":
+            if c in _WS:
+                return st
+            if c == 0x2C:                               # ','
+                return ("obj_key_expect", stack)
+            if c == 0x7D:
+                return self._value_done(stack[:-1])
+            return None
+        if mode == "obj_key_expect":
+            if c in _WS:
+                return st
+            return ("key", stack) if c == 0x22 else None
+
+        # arrays
+        if mode == "arr_open":
+            if c in _WS:
+                return st
+            if c == 0x5D:                               # ']'
+                return self._value_done(stack[:-1])
+            return self._dispatch_value(stack, c)
+        if mode == "arr_post_val":
+            if c in _WS:
+                return st
+            if c == 0x2C:
+                return ("arr_val_expect", stack)
+            if c == 0x5D:
+                return self._value_done(stack[:-1])
+            return None
+        if mode == "arr_val_expect":
+            if c in _WS:
+                return st
+            return self._dispatch_value(stack, c)
+
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Schema → char NFA (Thompson construction, lazily determinized by stepping
+# on frozensets of NFA nodes)
+# ---------------------------------------------------------------------------
+
+
+class _Nfa:
+    """Mutable NFA builder: nodes hold byte-transitions + epsilon edges."""
+
+    def __init__(self):
+        self.trans: List[Dict[int, set]] = []
+        self.eps: List[set] = []
+
+    def node(self) -> int:
+        self.trans.append({})
+        self.eps.append(set())
+        return len(self.trans) - 1
+
+    def edge(self, a: int, c: int, b: int):
+        self.trans[a].setdefault(c, set()).add(b)
+
+    def eedge(self, a: int, b: int):
+        self.eps[a].add(b)
+
+
+def _build(nfa: _Nfa, rx, a: int, b: int):
+    """Wire regex AST ``rx`` between nodes a → b."""
+    kind = rx[0]
+    if kind == "lit":
+        cur = a
+        data = rx[1]
+        for i, c in enumerate(data):
+            nxt = b if i == len(data) - 1 else nfa.node()
+            nfa.edge(cur, c, nxt)
+            cur = nxt
+        if not data:
+            nfa.eedge(a, b)
+    elif kind == "cls":
+        for c in rx[1]:
+            nfa.edge(a, c, b)
+    elif kind == "seq":
+        parts = rx[1]
+        if not parts:
+            nfa.eedge(a, b)
+        else:
+            cur = a
+            for i, p in enumerate(parts):
+                nxt = b if i == len(parts) - 1 else nfa.node()
+                _build(nfa, p, cur, nxt)
+                cur = nxt
+    elif kind == "alt":
+        for p in rx[1]:
+            _build(nfa, p, a, b)
+    elif kind == "star":
+        mid = nfa.node()
+        nfa.eedge(a, mid)
+        _build(nfa, rx[1], mid, mid)
+        nfa.eedge(mid, b)
+    elif kind == "opt":
+        nfa.eedge(a, b)
+        _build(nfa, rx[1], a, b)
+    else:  # pragma: no cover
+        raise AssertionError(kind)
+
+
+def _lit(s: bytes):
+    return ("lit", s)
+
+
+def _cls(s):
+    return ("cls", frozenset(s if not isinstance(s, (bytes, bytearray))
+                             else bytes(s)))
+
+
+def _seq(*parts):
+    return ("seq", tuple(parts))
+
+
+def _alt(*parts):
+    return ("alt", tuple(parts))
+
+
+def _star(p):
+    return ("star", p)
+
+
+def _plus(p):
+    return _seq(p, _star(p))
+
+
+def _opt(p):
+    return ("opt", p)
+
+
+_RX_WS = _star(_cls(b" \t\n\r"))
+_RX_STRING = _seq(
+    _lit(b'"'),
+    _star(_alt(
+        _cls(_STR_BODY),
+        _seq(_lit(b"\\"), _alt(
+            _cls(_ESC_ONE),
+            _seq(_lit(b"u"), _cls(_HEX), _cls(_HEX), _cls(_HEX),
+                 _cls(_HEX)))))),
+    _lit(b'"'))
+_RX_INT = _seq(_opt(_lit(b"-")),
+               _alt(_lit(b"0"), _seq(_cls(b"123456789"), _star(_cls(_DIGITS)))))
+_RX_NUMBER = _seq(_RX_INT,
+                  _opt(_seq(_lit(b"."), _plus(_cls(_DIGITS)))),
+                  _opt(_seq(_cls(b"eE"), _opt(_cls(b"+-")),
+                            _plus(_cls(_DIGITS)))))
+_RX_BOOL = _alt(_lit(b"true"), _lit(b"false"))
+_RX_NULL = _lit(b"null")
+
+_UNSUPPORTED = ("$ref", "patternProperties", "allOf", "not",
+                "if", "then", "else")
+
+
+def schema_to_rx(schema) -> tuple:
+    """Compile a JSON-schema subtree to a regex AST. Raises ValueError on
+    constructs outside the documented subset."""
+    if schema is True or schema == {}:
+        # any value: approximate with the scalar types + flat containers is
+        # wrong; instead reject — callers wanting "any JSON" should use
+        # json_object mode's exact machine.
+        raise ValueError("unconstrained subschema ({} / true) is not "
+                         "supported inside json_schema; give it a type")
+    if not isinstance(schema, dict):
+        raise ValueError(f"schema must be an object, got {type(schema)}")
+    for k in _UNSUPPORTED:
+        if k in schema:
+            raise ValueError(f"unsupported json_schema keyword: {k}")
+    if isinstance(schema.get("additionalProperties"), dict):
+        raise ValueError("additionalProperties with a schema is unsupported")
+    if "enum" in schema or "const" in schema:
+        vals = schema.get("enum", [schema.get("const")])
+        outs = []
+        for v in vals:
+            if isinstance(v, (dict, list)):
+                raise ValueError("enum/const of containers is unsupported")
+            outs.append(_lit(json.dumps(v).encode()))
+        return _alt(*outs)
+    if "anyOf" in schema or "oneOf" in schema:
+        subs = schema.get("anyOf") or schema.get("oneOf")
+        return _alt(*[schema_to_rx(s) for s in subs])
+
+    t = schema.get("type")
+    if isinstance(t, list):
+        return _alt(*[schema_to_rx({**schema, "type": one}) for one in t])
+    if t == "string":
+        return _RX_STRING
+    if t == "number":
+        return _RX_NUMBER
+    if t == "integer":
+        return _RX_INT
+    if t == "boolean":
+        return _RX_BOOL
+    if t == "null":
+        return _RX_NULL
+    if t == "array":
+        items = schema.get("items")
+        if items is None:
+            raise ValueError("array schema requires items")
+        item = schema_to_rx(items)
+        more = _star(_seq(_RX_WS, _lit(b","), _RX_WS, item))
+        body = _seq(item, more)
+        if int(schema.get("minItems", 0)) == 0:
+            body = _opt(body)
+        return _seq(_lit(b"["), _RX_WS, body, _RX_WS, _lit(b"]"))
+    if t == "object":
+        props = schema.get("properties")
+        if not props:
+            raise ValueError("object schema requires properties")
+        required = set(schema.get("required", list(props.keys())))
+        entries = [(k, _seq(_lit(json.dumps(k).encode()), _RX_WS,
+                            _lit(b":"), _RX_WS, schema_to_rx(v)))
+                   for k, v in props.items()]
+        req = [(k, e) for k, e in entries if k in required]
+        opt = [(k, e) for k, e in entries if k not in required]
+        if req:
+            body = req[0][1]
+            for _, e in req[1:]:
+                body = _seq(body, _RX_WS, _lit(b","), _RX_WS, e)
+            for _, e in opt:
+                body = _seq(body, _opt(_seq(_RX_WS, _lit(b","), _RX_WS, e)))
+        else:
+            # no required props: each optional in order, chained so commas
+            # stay valid (first present prop has no leading comma)
+            body = None
+            for _, e in opt:
+                body = e if body is None else \
+                    _seq(body, _opt(_seq(_RX_WS, _lit(b","), _RX_WS, e)))
+            body = _opt(body)
+        return _seq(_lit(b"{"), _RX_WS, body, _RX_WS, _lit(b"}"))
+    raise ValueError(f"unsupported schema type: {t!r}")
+
+
+class NfaMachine:
+    """Char machine over a compiled NFA; states are frozensets of nodes."""
+
+    def __init__(self, rx):
+        nfa = _Nfa()
+        self._start_node = nfa.node()
+        self._accept = nfa.node()
+        _build(nfa, _seq(_RX_WS, rx, _RX_WS), self._start_node, self._accept)
+        self._nfa = nfa
+
+    def _closure(self, nodes) -> frozenset:
+        out, work = set(nodes), list(nodes)
+        while work:
+            n = work.pop()
+            for m in self._nfa.eps[n]:
+                if m not in out:
+                    out.add(m)
+                    work.append(m)
+        return frozenset(out)
+
+    def start(self):
+        return self._closure({self._start_node})
+
+    def step(self, st, c: int):
+        nxt = set()
+        for n in st:
+            nxt.update(self._nfa.trans[n].get(c, ()))
+        if not nxt:
+            return None
+        return self._closure(nxt)
+
+    def accepting(self, st) -> bool:
+        return self._accept in st
+
+
+# ---------------------------------------------------------------------------
+# Token-level wrapper
+# ---------------------------------------------------------------------------
+
+
+def token_byte_table(tokenizer) -> List[Optional[bytes]]:
+    """token id → exact byte string, or None (never allowed: specials,
+    unrepresentable artifacts). Handles our ByteTokenizer, byte-level-BPE HF
+    tokenizers (GPT-2 unicode-to-byte map — Qwen/Llama-3/OPT/Phi), and
+    sentencepiece-style '▁' tokenizers (Gemma/Mistral); falls back to
+    per-token decode when no token-string view exists."""
+    V = tokenizer.vocab_size
+    inner = getattr(tokenizer, "_tok", None)
+    out: List[Optional[bytes]] = [None] * V
+    if inner is None:
+        # ByteTokenizer: id == byte for < 256; specials stay None
+        for i in range(min(256, V)):
+            out[i] = bytes([i])
+        return out
+
+    specials = set(getattr(inner, "all_special_ids", []) or [])
+    # GPT-2 byte-level unicode map (the printable stand-ins byte-level BPE
+    # tokenizers store token strings in)
+    bs = list(range(0x21, 0x7F)) + list(range(0xA1, 0xAD)) + \
+        list(range(0xAE, 0x100))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    uni2byte = {chr(c): b for b, c in zip(bs, cs)}
+
+    try:
+        toks = inner.convert_ids_to_tokens(list(range(V)))
+    except Exception:
+        toks = None
+    if toks is not None:
+        sample = [t for t in toks[:2000] if t]
+        byte_level = sample and all(ch in uni2byte for t in sample[:50]
+                                    for ch in t)
+        for i, t in enumerate(toks):
+            if i in specials or not t:
+                continue
+            if byte_level:
+                try:
+                    out[i] = bytes(uni2byte[ch] for ch in t)
+                    continue
+                except KeyError:
+                    pass
+            out[i] = t.replace("▁", " ").encode("utf-8")
+        return out
+    for i in range(V):                    # last-resort: lossy single decodes
+        if i in specials:
+            continue
+        s = inner.decode([i])
+        if s and "�" not in s:
+            out[i] = s.encode("utf-8")
+    return out
+
+
+class TokenGrammar:
+    """A char machine lifted to token-level masks over one vocabulary.
+
+    States are interned to dense ids; per-state artifacts are cached:
+    ``_rows[sid]`` = 256-wide next-sid table (-1 = reject) and
+    ``_masks[sid]`` = packed uint32 allow-bitmask over the vocab (bit v of
+    word v>>5). The mask computation walks ALL tokens in parallel with
+    numpy, grouping by unique live state per byte position — cost is
+    O(L × unique_states × V) elementwise, a few ms for a 152k vocab, paid
+    once per distinct grammar state ever visited.
+    """
+
+    def __init__(self, machine, tokenizer, eos_ids):
+        self._m = machine
+        self._eos = [e for e in (eos_ids or []) if e is not None]
+        tb = token_byte_table(tokenizer)
+        self.vocab_size = len(tb)
+        self.n_words = (self.vocab_size + 31) // 32
+        L = max((len(b) for b in tb if b), default=1)
+        self._tbmat = np.zeros((self.vocab_size, L), np.uint8)
+        self._tlen = np.zeros(self.vocab_size, np.int32)
+        self._no_bytes = np.ones(self.vocab_size, bool)
+        for i, b in enumerate(tb):
+            if b:
+                self._tbmat[i, :len(b)] = np.frombuffer(b, np.uint8)
+                self._tlen[i] = len(b)
+                self._no_bytes[i] = False
+        self._tb = tb
+        self._ids: Dict[object, int] = {}
+        self._by_id: List[object] = []
+        self._rows: Dict[int, np.ndarray] = {}
+        self._masks: Dict[int, np.ndarray] = {}
+        # whitespace token ids: allowed in accepting states alongside eos so
+        # a min_tokens-banned eos can never leave an all-masked row
+        self._ws_ids = [i for i, b in enumerate(tb)
+                        if b and all(c in _WS for c in b)]
+        self.start_sid = self._sid(machine.start())
+
+    def _sid(self, st) -> int:
+        sid = self._ids.get(st)
+        if sid is None:
+            sid = len(self._by_id)
+            self._ids[st] = sid
+            self._by_id.append(st)
+        return sid
+
+    def _row(self, sid: int) -> np.ndarray:
+        row = self._rows.get(sid)
+        if row is None:
+            st = self._by_id[sid]
+            row = np.full(256, -1, np.int32)
+            for c in range(256):
+                nxt = self._m.step(st, c)
+                if nxt is not None:
+                    row[c] = self._sid(nxt)
+            self._rows[sid] = row
+        return row
+
+    def accepting(self, sid: int) -> bool:
+        return self._m.accepting(self._by_id[sid])
+
+    def advance(self, sid: int, token_id: int) -> int:
+        """New state id after emitting ``token_id``; -1 = rejected."""
+        if token_id in self._eos:
+            return sid if self.accepting(sid) else -1
+        if token_id >= self.vocab_size or self._no_bytes[token_id]:
+            return -1
+        for c in self._tbmat[token_id, :self._tlen[token_id]]:
+            row = self._row(sid)
+            sid = int(row[c])
+            if sid < 0:
+                return -1
+        return sid
+
+    def mask_words(self, sid: int) -> np.ndarray:
+        """Packed uint32 allow-bitmask for machine state ``sid``."""
+        m = self._masks.get(sid)
+        if m is not None:
+            return m
+        V = self.vocab_size
+        cur = np.full(V, sid, np.int64)
+        cur[self._no_bytes] = -1
+        for p in range(self._tbmat.shape[1]):
+            act = (p < self._tlen) & (cur >= 0)
+            if not act.any():
+                break
+            nxt = cur.copy()
+            for u in np.unique(cur[act]):
+                row = self._row(int(u))
+                sel = act & (cur == u)
+                nxt[sel] = row[self._tbmat[sel, p]]
+            cur = nxt
+        allowed = cur >= 0
+        if self.accepting(sid):
+            for e in self._eos:
+                if e < V:
+                    allowed[e] = True
+        if not allowed.any():
+            # unreachable by construction (accepting states allow ws + eos;
+            # others always have a continuation) — but a vocab missing the
+            # needed bytes must finish, not wedge
+            for e in self._eos:
+                if e < V:
+                    allowed[e] = True
+        words = np.zeros(self.n_words, np.uint32)
+        idx = np.nonzero(allowed)[0]
+        np.bitwise_or.at(words, idx >> 5,
+                         (np.uint32(1) << (idx & 31).astype(np.uint32)))
+        self._masks[sid] = words
+        return words
+
+
+class GuidedState:
+    """Per-request cursor over a shared TokenGrammar."""
+
+    __slots__ = ("grammar", "sid", "dead")
+
+    def __init__(self, grammar: TokenGrammar):
+        self.grammar = grammar
+        self.sid = grammar.start_sid
+        self.dead = False
+
+    def clone(self) -> "GuidedState":
+        return GuidedState(self.grammar)
+
+    def mask_words(self) -> np.ndarray:
+        if self.dead:
+            # force-finish: only eos (and ws) remain
+            g = self.grammar
+            words = np.zeros(g.n_words, np.uint32)
+            for e in g._eos + g._ws_ids:
+                if e < g.vocab_size:
+                    words[e >> 5] |= np.uint32(1) << np.uint32(e & 31)
+            return words
+        return self.grammar.mask_words(self.sid)
+
+    def advance(self, token_id: int) -> None:
+        if self.dead:
+            return
+        nxt = self.grammar.advance(self.sid, token_id)
+        if nxt < 0:
+            self.dead = True
+        else:
+            self.sid = nxt
+
+    @property
+    def complete(self) -> bool:
+        return (not self.dead) and self.grammar.accepting(self.sid)
+
+
+# ---------------------------------------------------------------------------
+# Server-facing entry
+# ---------------------------------------------------------------------------
+
+_GRAMMAR_CACHE: Dict[Tuple[int, str], TokenGrammar] = {}
+_CACHE_CAP = 64
+
+
+def grammar_for(tokenizer, response_format: dict, eos_ids) -> TokenGrammar:
+    """Resolve an OpenAI ``response_format`` dict to a (cached) TokenGrammar.
+
+    Accepts {"type": "json_object"} and {"type": "json_schema",
+    "json_schema": {"schema": {...}}} (also tolerates the schema directly
+    under "schema" — the vLLM extension shape). Raises ValueError for
+    malformed input; the server maps that to HTTP 400.
+    """
+    t = response_format.get("type")
+    if t == "json_object":
+        key = (id(tokenizer), "json_object")
+        g = _GRAMMAR_CACHE.get(key)
+        if g is None:
+            g = TokenGrammar(JsonMachine(top="object"), tokenizer, eos_ids)
+            _cache_put(key, g)
+        return g
+    if t == "json_schema":
+        spec = response_format.get("json_schema", response_format)
+        schema = spec.get("schema") if isinstance(spec, dict) else None
+        if not isinstance(schema, dict):
+            raise ValueError("json_schema response_format requires "
+                             "json_schema.schema to be an object")
+        key = (id(tokenizer), json.dumps(schema, sort_keys=True))
+        g = _GRAMMAR_CACHE.get(key)
+        if g is None:
+            g = TokenGrammar(NfaMachine(schema_to_rx(schema)), tokenizer,
+                             eos_ids)
+            _cache_put(key, g)
+        return g
+    raise ValueError(f"unsupported response_format type: {t!r} "
+                     "(expected json_object or json_schema)")
+
+
+def _cache_put(key, g):
+    if len(_GRAMMAR_CACHE) >= _CACHE_CAP:
+        _GRAMMAR_CACHE.pop(next(iter(_GRAMMAR_CACHE)))
+    _GRAMMAR_CACHE[key] = g

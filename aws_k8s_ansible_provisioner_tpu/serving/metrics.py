@@ -171,10 +171,13 @@ class EngineMetrics:
             "Prompt tokens served from the prefix cache instead of prefill"))
         self.spec_drafted_tokens = r.register(Counter(
             "tpu_serve_spec_drafted_tokens_total",
-            "Draft tokens proposed by prompt-lookup speculative decoding"))
+            "Draft tokens proposed (prompt-lookup or draft-model)"))
         self.spec_accepted_tokens = r.register(Counter(
             "tpu_serve_spec_accepted_tokens_total",
             "Draft tokens accepted by the verify pass"))
+        self.spec_acceptance_rate = r.register(Gauge(
+            "tpu_serve_spec_acceptance_rate",
+            "Cumulative accepted/drafted ratio of speculative decoding"))
         # Paged-KV pool health (vLLM publishes the same trio as
         # vllm:num_preemptions/gpu_cache_usage_perc): preemption spikes or a
         # pinned-high page gauge mean the pool is undersized for the load.

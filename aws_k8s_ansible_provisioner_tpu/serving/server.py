@@ -334,6 +334,9 @@ class Handler(BaseHTTPRequestHandler):
         if min_tokens < 0:
             return self._error(400, "'min_tokens' must be >= 0")
         stream = bool(body.get("stream", False))
+        # vLLM ``ignore_eos``: generate to the max_tokens budget regardless
+        # of eos (bench/load harnesses depend on it for deterministic sizes)
+        ignore_eos = bool(body.get("ignore_eos", False))
         try:
             n_choices = int(body.get("n", 1))
         except (TypeError, ValueError):
@@ -431,6 +434,23 @@ class Handler(BaseHTTPRequestHandler):
         if so and not stream:
             return self._error(400, "'stream_options' requires stream=true")
         include_usage = bool(so.get("include_usage", False))
+        # OpenAI ``response_format``: json_object / json_schema constrained
+        # output via the grammar-mask sampler (serving/guided.py). The
+        # compiled grammar is cached per (tokenizer, schema); each sibling
+        # request gets its own FSM cursor (engine.submit wraps the grammar).
+        guided = None
+        rf = body.get("response_format")
+        if rf is not None:
+            if not isinstance(rf, dict):
+                return self._error(400, "'response_format' must be an object")
+            if rf.get("type") not in (None, "text"):
+                from aws_k8s_ansible_provisioner_tpu.serving.guided import (
+                    grammar_for)
+                try:
+                    guided = grammar_for(st.tokenizer, rf,
+                                         sorted(st.engine._eos_set))
+                except ValueError as e:
+                    return self._error(400, f"response_format: {e}")
 
         prompt_ids = st.tokenizer.encode(prompt_text)
         if not prompt_ids:
@@ -456,7 +476,7 @@ class Handler(BaseHTTPRequestHandler):
                 frequency_penalty=frequency_penalty,
                 repetition_penalty=repetition_penalty,
                 stop_token_ids=stop_token_ids, min_tokens=min_tokens,
-                logit_bias=logit_bias,
+                logit_bias=logit_bias, guided=guided, ignore_eos=ignore_eos,
                 seed=None if seed is None else seed + i,
                 **({"out_queue": _NotifyQueue(notify)} if notify else {}))
                 for i in range(best_of)]

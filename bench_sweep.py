@@ -10,6 +10,16 @@ Usage:
     python bench_sweep.py                  # default grid (paged A/B + horizon)
     python bench_sweep.py --cap 300        # per-config seconds
     TPU_BENCH_BATCH=64 python bench_sweep.py --grid paged=0,1
+    python bench_sweep.py --router 16      # router-under-load mode (CPU)
+
+Router mode (VERDICT r4 next #8) drives the REAL gateway in front of real
+in-process engine replicas with N concurrent client streams and reports
+aggregate tok/s, TTFT percentiles, prefix-affinity hit rate (engines'
+prefix-cache counters), per-replica spread, and failover latency after a
+backend death — the load shape of the reference's PromQL cookbook
+(/root/reference/otel-observability-setup.yaml:754-775). It measures ROUTER
+mechanics, so it runs on CPU with the tiny model and writes
+``ROUTER_BENCH.json``.
 """
 
 from __future__ import annotations
@@ -40,6 +50,197 @@ def parse_grid(spec: str) -> dict:
     return grid
 
 
+def _scrape_counter(port: int, name: str) -> float:
+    """Sum a counter's samples from a replica's /metrics text."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+    except Exception:
+        return 0.0
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and " " in line:
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def router_bench(n_streams: int, n_groups: int, n_replicas: int,
+                 n_requests: int, out_path: str) -> int:
+    """Drive the real router + real engine replicas with concurrent streams.
+
+    Affinity design: requests belong to ``n_groups`` conversation groups
+    sharing a long prompt prefix. The router's prefix-affinity should pin a
+    group to one replica, so the engines' paged prefix caches hit on every
+    request after a group's first — ``prefix_hit_rate`` is measured from the
+    engines' own counters, not inferred from routing tables.
+    """
+    import statistics
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")   # router mechanics, not chip perf
+    import jax.numpy as jnp
+
+    from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+    from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+    from aws_k8s_ansible_provisioner_tpu.serving.router import (
+        BackendPool, RouterHandler, RouterMetrics, start_load_poller)
+    from aws_k8s_ansible_provisioner_tpu.serving.server import (
+        build_state, serve)
+    from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+    BASE = 18550
+    stops = []
+    for i in range(n_replicas):
+        tok = ByteTokenizer()
+        cfg = tiny_qwen3(vocab_size=tok.vocab_size,
+                         eos_token_id=tok.eos_token_id, max_seq_len=512)
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        serving = ServingConfig(model="tiny-qwen3", max_decode_slots=8,
+                                max_cache_len=512,
+                                prefill_buckets=(64, 128, 384),
+                                dtype="float32")
+        state = build_state(serving, model_cfg=cfg, params=params,
+                            tokenizer=tok)
+        ready, stop = threading.Event(), threading.Event()
+        threading.Thread(target=serve,
+                         args=(state, "127.0.0.1", BASE + i, ready, stop),
+                         daemon=True).start()
+        assert ready.wait(60), f"replica {i} failed to start"
+        stops.append(stop)
+    addrs = ",".join(f"127.0.0.1:{BASE + i}" for i in range(n_replicas))
+    RouterHandler.pool = BackendPool(addrs, cooldown_s=5.0)
+    RouterHandler.metrics = RouterMetrics()
+    poll_stop = threading.Event()
+    start_load_poller(RouterHandler.pool, interval_s=0.2, stop=poll_stop)
+    router = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    rurl = f"http://127.0.0.1:{router.server_port}"
+
+    hits0 = sum(_scrape_counter(BASE + i,
+                                "tpu_serve_prefix_cache_hits_total")
+                for i in range(n_replicas))
+    per_replica0 = [_scrape_counter(BASE + i,
+                                    "tpu_serve_generated_tokens_total")
+                    for i in range(n_replicas)]
+
+    # one long shared prefix per conversation group (affinity + prefix-cache
+    # fuel; > prefix_reuse_min_pages * page_size tokens so burst admissions
+    # still take the match), plus a short per-request suffix
+    prefixes = [f"conversation {g}: " + ("context " * 34) for g in
+                range(n_groups)]
+    ttfts, toks, errors = [], [], []
+    lock = threading.Lock()
+    work = list(range(n_requests))
+
+    def client():
+        while True:
+            with lock:
+                if not work:
+                    return
+                i = work.pop()
+            g = i % n_groups
+            body = json.dumps({
+                "model": "tiny-qwen3", "stream": True, "max_tokens": 24,
+                "prompt": prefixes[g] + f"turn {i}", "ignore_eos": True,
+            }).encode()
+            req = urllib.request.Request(
+                rurl + "/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.monotonic()
+            first, n_tok = None, 0
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    for line in r:
+                        if line.startswith(b"data: ") and \
+                                not line.startswith(b"data: [DONE]"):
+                            if first is None:
+                                first = time.monotonic() - t0
+                            n_tok += 1
+            except Exception as e:     # noqa: BLE001 — record, don't die
+                with lock:
+                    errors.append(str(e)[:100])
+                continue
+            with lock:
+                if first is not None:
+                    ttfts.append(first)
+                toks.append(n_tok)
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=client) for _ in range(n_streams)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+
+    hits1 = sum(_scrape_counter(BASE + i,
+                                "tpu_serve_prefix_cache_hits_total")
+                for i in range(n_replicas))
+    per_replica1 = [_scrape_counter(BASE + i,
+                                    "tpu_serve_generated_tokens_total")
+                    for i in range(n_replicas)]
+    spread = [round(b - a, 1) for a, b in zip(per_replica0, per_replica1)]
+    done = len(toks)
+    hit_eligible = max(1, done - n_groups)   # first of each group must miss
+
+    # failover: kill replica 0, then time the first successful completion
+    stops[0].set()
+    t0 = time.monotonic()
+    fo_ms = None
+    for _ in range(20):
+        try:
+            body = json.dumps({"model": "tiny-qwen3", "prompt": "after death",
+                               "max_tokens": 4}).encode()
+            req = urllib.request.Request(
+                rurl + "/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+            fo_ms = 1e3 * (time.monotonic() - t0)
+            break
+        except Exception:
+            time.sleep(0.2)
+
+    poll_stop.set()
+    router.shutdown()
+    for s in stops[1:]:
+        s.set()
+    ts = sorted(ttfts)
+    result = {
+        "mode": "router_bench",
+        "platform": "cpu",
+        "n_streams": n_streams, "n_groups": n_groups,
+        "n_replicas": n_replicas,
+        "requests_done": done, "requests_failed": len(errors),
+        "wall_s": round(wall, 2),
+        "agg_toks_per_s": round(sum(toks) / wall, 1) if wall else 0.0,
+        "requests_per_s": round(done / wall, 2) if wall else 0.0,
+        "ttft_p50_ms": round(1e3 * ts[len(ts) // 2], 1) if ts else None,
+        "ttft_p95_ms": round(1e3 * ts[int(len(ts) * 0.95)], 1) if ts else None,
+        "ttft_mean_ms": round(1e3 * statistics.mean(ts), 1) if ts else None,
+        "prefix_cache_hits": int(hits1 - hits0),
+        "prefix_hit_rate": round((hits1 - hits0) / hit_eligible, 3),
+        "per_replica_generated_tokens": spread,
+        "failover_first_success_ms": round(fo_ms, 1) if fo_ms else None,
+        "router_failovers": int(RouterHandler.metrics.failovers.total()),
+        "errors": errors[:5],
+    }
+    with open(out_path, "w") as f:
+        f.write(json.dumps(result, indent=1) + "\n")
+    print(json.dumps(result))
+    return 0 if done == n_requests and fo_ms is not None else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cap", type=float, default=420.0,
@@ -47,7 +248,18 @@ def main() -> int:
     ap.add_argument("--grid", default="",
                     help="e.g. 'paged=0,1;horizon=64,96,128'")
     ap.add_argument("--out", default="bench_sweep_results.jsonl")
+    ap.add_argument("--router", type=int, default=0, metavar="N_STREAMS",
+                    help="router-under-load mode: N concurrent client "
+                         "streams against real replicas (CPU)")
+    ap.add_argument("--router-groups", type=int, default=6)
+    ap.add_argument("--router-replicas", type=int, default=2)
+    ap.add_argument("--router-requests", type=int, default=48)
+    ap.add_argument("--router-out", default="ROUTER_BENCH.json")
     args = ap.parse_args()
+    if args.router > 0:
+        return router_bench(args.router, args.router_groups,
+                            args.router_replicas, args.router_requests,
+                            args.router_out)
     grid = parse_grid(args.grid) if args.grid else DEFAULT_GRID
     keys = sorted(grid)
     combos = list(itertools.product(*(grid[k] for k in keys)))

@@ -148,10 +148,14 @@ def test_spec_under_tp_mesh_token_parity(cpu_devices):
     assert eng.metrics.spec_drafted_tokens.total() > 0
 
 
-def test_spec_disabled_under_dp_mesh(cpu_devices):
-    """dp shards slots — per-group accept lengths would desync the groups'
-    fused horizons, so the engine must keep plain decode (and still hold
-    token parity) under any dp > 1 mesh."""
+@pytest.mark.parametrize("dp,tp", [(2, 1), (2, 2)])
+def test_spec_parity_under_dp_mesh(cpu_devices, dp, tp):
+    """Speculation under dp (and dp x tp) meshes (VERDICT r4 next #6: the
+    old fence disabled spec engine-wide for the flagship multi-replica dp
+    config). dp shards the SLOT axis; accept lengths are per-slot host
+    state exactly like plain decode's variable lengths, so the meshed spec
+    engine must emit exactly the single-device plain-decode tokens — with
+    drafts actually proposed."""
     from aws_k8s_ansible_provisioner_tpu.config import MeshConfig
     from aws_k8s_ansible_provisioner_tpu.parallel.mesh import make_mesh
 
@@ -161,20 +165,21 @@ def test_spec_disabled_under_dp_mesh(cpu_devices):
     prompts = _prompts(cfg, rng)
     base = ServingConfig(max_decode_slots=4, max_cache_len=128,
                          prefill_buckets=(32,), dtype="float32",
+                         attention_impl="pallas",
                          prefix_cache=False, decode_horizon=4)
     ref, _ = _run(cfg, params, base, prompts)
 
     spec = dataclasses.replace(base, spec_decode=True, spec_k=4, spec_ngram=3)
-    mesh = make_mesh(MeshConfig(dp=2, tp=1), devices=jax.devices("cpu"))
+    mesh = make_mesh(MeshConfig(dp=dp, tp=tp), devices=jax.devices("cpu"))
     eng = Engine(cfg, params, spec, mesh=mesh)
-    assert not eng._spec_mesh_ok
+    assert eng._spec_mesh_ok
     reqs = [eng.submit(Request(prompt_ids=list(p), max_tokens=24,
                                ignore_eos=True)) for p in prompts]
     for _ in range(10000):
         if not eng.step():
             break
     assert [r.generated for r in reqs] == ref
-    assert eng.metrics.spec_drafted_tokens.total() == 0
+    assert eng.metrics.spec_drafted_tokens.total() > 0
 
 
 def test_logprobs_neighbor_does_not_disable_spec():
