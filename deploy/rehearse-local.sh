@@ -1,0 +1,177 @@
+#!/usr/bin/env bash
+# Local deploy rehearsal: EXECUTE every playbook L1→L5 (+ teardown) with the
+# in-repo executor (deploy/miniansible.py), cloud/cluster binaries shimmed on
+# PATH (deploy/shims/), and the L4 acceptance gate aimed at a REAL engine +
+# router started locally on CPU — VERDICT r4 next #3 ("a no-Docker rehearsal
+# that executes, not parses, every playbook ... passing the /v1/models gate
+# against a locally started real engine").
+#
+# Isolation: the whole run sits in an unshare(1) MOUNT NAMESPACE with a
+# throwaway copy of /etc (and fresh binds over the few other absolute paths
+# the playbooks write), so nothing escapes to the host filesystem; retries
+# are time-compressed via MINI_ANSIBLE_DELAY_SCALE.
+#
+# Artifacts: REHEARSAL_LOCAL.log (full transcript), REHEARSAL_LOCAL.json
+# (machine-readable verdict incl. the per-binary shim journals).
+#
+# Usage: deploy/rehearse-local.sh            (from the repo root)
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+PYTHON="${PYTHON:-python3}"
+
+if [[ "${REHEARSE_INNER:-}" != "1" ]]; then
+    # mountpoints that may not exist yet; record creations for cleanup
+    created=()
+    for d in /opt/tpu-cluster /opt/local-path-provisioner /root/.kube \
+             /root/.cache/huggingface; do
+        if [[ ! -e "$d" ]]; then mkdir -p "$d"; created+=("$d"); fi
+    done
+    rc=0
+    REHEARSE_INNER=1 unshare --mount bash "${BASH_SOURCE[0]}" "$@" || rc=$?
+    for d in "${created[@]:-}"; do [[ -n "$d" ]] && rmdir "$d" 2>/dev/null || true; done
+    exit "$rc"
+fi
+
+# ---- inside the mount namespace -------------------------------------------
+WORK="$(mktemp -d /tmp/rehearse.XXXXXX)"
+export REHEARSE_STATE="$WORK/state"
+mkdir -p "$REHEARSE_STATE" "$WORK/etc" "$WORK/opt-tpu" "$WORK/opt-lpp" \
+    "$WORK/home" "$WORK/root-kube" "$WORK/usrlocal" "$WORK/hfcache"
+cp -a /etc/. "$WORK/etc/" 2>/dev/null || true
+cp -a /usr/local/. "$WORK/usrlocal/" 2>/dev/null || true
+mount --bind "$WORK/etc" /etc
+mount --bind "$WORK/opt-tpu" /opt/tpu-cluster
+mount --bind "$WORK/opt-lpp" /opt/local-path-provisioner
+mount --bind "$WORK/home" /home
+mount --bind "$WORK/root-kube" /root/.kube
+mount --bind "$WORK/usrlocal" /usr/local
+mount --bind "$WORK/hfcache" /root/.cache/huggingface
+echo "hf_rehearsal_token" > /root/.cache/huggingface/token
+mkdir -p /usr/local/bin /etc/apt/keyrings
+touch /usr/local/bin/helm     # 'creates:' guard for the network helm install
+
+export PATH="$REPO/deploy/shims:$PATH"
+export MINI_ANSIBLE_DELAY_SCALE=0.05
+export MINI_ANSIBLE_WAITFOR_SKIP=1
+export MINI_ANSIBLE_REHEARSAL=1
+ENGINE_PORT=18620
+ROUTER_PORT=18621
+export REHEARSE_GW_ADDR="127.0.0.1:${ROUTER_PORT}"
+export REHEARSE_ENGINE_IP="127.0.0.1"
+LOG="$REPO/REHEARSAL_LOCAL.log"
+: > "$LOG"
+JOURNAL="$REHEARSE_STATE/tasks.jsonl"
+
+say() { echo "$@" | tee -a "$LOG"; }
+
+say "=== local deploy rehearsal $(date -u +%FT%TZ) ==="
+say "--- generating single-source group_vars (deploy-tpu-cluster.sh contract)"
+mkdir -p "$REPO/deploy/group_vars"
+"$PYTHON" -m aws_k8s_ansible_provisioner_tpu.config --ansible-vars \
+    > "$REPO/deploy/group_vars/all.yaml"
+
+MODEL="$("$PYTHON" - <<'EOF'
+import yaml
+print(yaml.safe_load(open("deploy/group_vars/all.yaml"))["model"])
+EOF
+)"
+SERVING_PORT="$("$PYTHON" - <<'EOF'
+import yaml
+print(yaml.safe_load(open("deploy/group_vars/all.yaml"))["serving_port"])
+EOF
+)"
+
+say "--- starting REAL engine (CPU dry-run weights, model id ${MODEL}) + router"
+JAX_COMPILATION_CACHE_DIR="$WORK/jaxcache" \
+JAX_PLATFORMS="" "$PYTHON" -m aws_k8s_ansible_provisioner_tpu.serving.server \
+    --model "$MODEL" --platform cpu --port "$ENGINE_PORT" \
+    --max-decode-slots 4 --max-cache-len 256 --dtype float32 --no-warmup \
+    >> "$LOG" 2>&1 &
+ENGINE_PID=$!
+"$PYTHON" -m aws_k8s_ansible_provisioner_tpu.serving.router \
+    --backend-service "127.0.0.1:${ENGINE_PORT}" --port "$ROUTER_PORT" \
+    >> "$LOG" 2>&1 &
+ROUTER_PID=$!
+trap 'kill $ENGINE_PID $ROUTER_PID 2>/dev/null || true' EXIT
+for i in $(seq 1 120); do
+    curl -sf "http://127.0.0.1:${ROUTER_PORT}/v1/models" >/dev/null && break
+    sleep 2
+done
+curl -sf "http://127.0.0.1:${ROUTER_PORT}/v1/models" >/dev/null \
+    || { say "FATAL: local engine/router did not come up"; exit 3; }
+say "engine+router live at $REHEARSE_GW_ADDR"
+# the perf step scrapes ENGINE_IP:serving_port/metrics — alias the engine
+# port onto the configured serving_port via socat-less python forwarder
+if [[ "$SERVING_PORT" != "$ENGINE_PORT" ]]; then
+    "$PYTHON" - "$SERVING_PORT" "$ENGINE_PORT" <<'EOF' >> "$LOG" 2>&1 &
+import socket, sys, threading
+lp, tp = int(sys.argv[1]), int(sys.argv[2])
+srv = socket.create_server(("127.0.0.1", lp))
+def pump(a, b):
+    try:
+        while True:
+            d = a.recv(65536)
+            if not d: break
+            b.sendall(d)
+    except OSError: pass
+    finally:
+        for s in (a, b):
+            try: s.close()
+            except OSError: pass
+while True:
+    c, _ = srv.accept()
+    u = socket.create_connection(("127.0.0.1", tp))
+    threading.Thread(target=pump, args=(c, u), daemon=True).start()
+    threading.Thread(target=pump, args=(u, c), daemon=True).start()
+EOF
+    FWD_PID=$!
+    trap 'kill $ENGINE_PID $ROUTER_PID $FWD_PID 2>/dev/null || true' EXIT
+fi
+
+run_play() {
+    local name="$1"; shift
+    say ""
+    say "=== [$name] $* ==="
+    "$PYTHON" "$REPO/deploy/miniansible.py" --journal "$JOURNAL" "$@" \
+        2>&1 | tee -a "$LOG"
+    return "${PIPESTATUS[0]}"
+}
+
+cd "$REPO"
+FAILED=""
+run_play L1 deploy/launch-tpu-vm.yaml || FAILED="L1"
+INV="$(ls -rt "$REPO"/tpu-inventory-*.ini 2>/dev/null | tail -1)"
+if [[ -z "$INV" ]]; then say "FATAL: L1 produced no inventory"; exit 4; fi
+say "using inventory: $INV (L1->L2 handoff contract)"
+[[ -z "$FAILED" ]] && { run_play L2 -i "$INV" deploy/kubernetes-single-node.yaml || FAILED="L2"; }
+[[ -z "$FAILED" ]] && { run_play L3 -i "$INV" deploy/serving-deploy.yaml || FAILED="L3"; }
+[[ -z "$FAILED" ]] && { run_play L4 -i "$INV" deploy/serving-test.yaml || FAILED="L4"; }
+[[ -z "$FAILED" ]] && { run_play L5 -i "$INV" deploy/otel-observability-setup.yaml || FAILED="L5"; }
+[[ -z "$FAILED" ]] && { run_play CLEANUP deploy/cleanup-tpu-vm.yaml || FAILED="CLEANUP"; }
+
+kill $ENGINE_PID $ROUTER_PID ${FWD_PID:-} 2>/dev/null || true
+
+say ""
+say "=== rehearsal summary ==="
+"$PYTHON" - "$JOURNAL" "$REHEARSE_STATE" "${FAILED:-none}" <<'EOF' | tee -a "$LOG" > "$REPO/REHEARSAL_LOCAL.json"
+import json, os, sys
+journal, state, failed = sys.argv[1], sys.argv[2], sys.argv[3]
+tasks = [json.loads(l) for l in open(journal)] if os.path.exists(journal) else []
+shims = {}
+for f in os.listdir(state):
+    if f.endswith(".jsonl"):
+        shims[f[:-6]] = sum(1 for _ in open(os.path.join(state, f)))
+print(json.dumps({
+    "ok": failed == "none",
+    "failed_layer": None if failed == "none" else failed,
+    "tasks_executed": len(tasks),
+    "tasks_failed": sum(1 for t in tasks if t.get("failed")),
+    "tasks_skipped": sum(1 for t in tasks if t.get("skipped")),
+    "shim_invocations": shims,
+    "gate": "/v1/models assert ran against a real engine through the real router",
+}, indent=1))
+EOF
+cat "$REPO/REHEARSAL_LOCAL.json" | tee -a "$LOG"
+[[ -z "$FAILED" ]] || exit 5
+say "REHEARSAL OK"
