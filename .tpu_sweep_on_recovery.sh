@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Fired by the session watcher the moment the TPU tunnel recovers: runs the
+# prioritized round-5 sweep (VERDICT r4 next #1/#2) and commits artifacts.
+# Priorities: (1) does the shipped paged path run on-chip at any batch?
+# (2) int8 weights A/B (roofline lever), (3) batch/horizon ceiling pushes.
+set -u
+cd /root/repo
+OUT=bench_sweep_r5.jsonl
+: > "$OUT"
+run() {
+    local label="$1"; shift
+    echo "=== sweep: $label ($*)" >&2
+    local line
+    line="$(env "$@" TPU_BENCH_CHILD_BUDGET_S=390 \
+        JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_compile_cache \
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+        JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES=-1 \
+        timeout 420 python bench.py --measure 2>"/tmp/sweep_${label}.err" \
+        | grep '^{' | tail -1)"
+    if [ -n "$line" ]; then
+        echo "{\"sweep\": \"$label\", ${line#\{}" >> "$OUT"
+    else
+        echo "{\"sweep\": \"$label\", \"error\": \"no result; see stderr\", \"stderr_tail\": $(tail -c 400 "/tmp/sweep_${label}.err" | python3 -c 'import json,sys; print(json.dumps(sys.stdin.read()))')}" >> "$OUT"
+    fi
+    echo "--- $label done" >&2
+}
+run bb8_b128       TPU_BENCH_PAGED=0 PALLAS_DECODE_BBLOCK=8
+run bb16_b128      TPU_BENCH_PAGED=0 PALLAS_DECODE_BBLOCK=16
+run paged_b64      TPU_BENCH_PAGED=1 TPU_BENCH_BATCH=64
+run w8_bb8_b128    TPU_BENCH_PAGED=0 PALLAS_DECODE_BBLOCK=8 TPU_BENCH_WEIGHTS=int8
+run dense_b192_bb8 TPU_BENCH_PAGED=0 TPU_BENCH_BATCH=192 PALLAS_DECODE_BBLOCK=8
+run dense_h128     TPU_BENCH_PAGED=0 TPU_BENCH_BATCH=128 TPU_BENCH_HORIZON=128 PALLAS_DECODE_BBLOCK=8
+run w8_b128        TPU_BENCH_PAGED=0 TPU_BENCH_WEIGHTS=int8
+run paged_b96      TPU_BENCH_PAGED=1 TPU_BENCH_BATCH=96
+echo "SWEEP COMPLETE" >&2

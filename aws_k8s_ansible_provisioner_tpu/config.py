@@ -707,6 +707,9 @@ class ServingConfig:
     # Attention backend: "xla" (fused SDPA fallback) or "pallas" (custom kernel).
     attention_impl: str = "auto"
     checkpoint_dir: str = ""
+    # Draft model for spec_method="draft": a (small) HF checkpoint dir; the
+    # server loads it unsharded beside the target (serving/draft.py).
+    draft_checkpoint_dir: str = ""
     chat_template: str = ""  # path to a .jinja file; empty = model family default
     mesh: MeshConfig = field(default_factory=MeshConfig)
 

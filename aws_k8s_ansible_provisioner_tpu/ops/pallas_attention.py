@@ -25,6 +25,7 @@ the XLA fallback in ops/attention.py, here with explicit VMEM control).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -199,6 +200,99 @@ def _decode_kernel_layer_q(lengths_ref,     # scalar prefetch [B] int32
         o_ref[0, :, :] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
+def _decode_kernel_layer_bb(lengths_ref,    # scalar prefetch [B] int32
+                            layer_ref,      # scalar prefetch [1] int32
+                            q_ref,          # [BB, Hq, D]
+                            k_ref,          # [1, BB, Hkv, CHUNK, D]
+                            v_ref,          # [1, BB, Hkv, CHUNK, D]
+                            o_ref,          # [BB, Hq, D]
+                            acc_ref, m_ref, l_ref,   # [BB, Hq, *]
+                            *, chunk: int, groups: int, scale: float,
+                            bb: int, window: int = 0, quant: bool = False,
+                            ks_ref=None, vs_ref=None):
+    """Batch-blocked flash decode: BB slots per grid step.
+
+    The round-5 TPU decomposition (BENCH_session_r5.json) put the decode
+    substep at ~3x its bandwidth bound; at grid (B=128, chunks=4) x 28
+    layers each step streams only ~0.5 MB, so fixed per-grid-step cost
+    (DMA issue + kernel overhead, ~1 us class) rivals the stream time
+    itself. Blocking BB slots into one grid step multiplies the DMA size
+    by BB and divides the step count by BB, pushing the kernel back toward
+    the stream bound. Trade: the chunk-skip clamp must cover the LONGEST
+    slot in the block (shorter slots' dead chunks ride along), so blocks
+    of similar-length slots waste nothing and mixed blocks pay up to
+    (max-min) extra rows — the engine's slot allocator is FCFS, which
+    correlates neighbors' ages. Gated by PALLAS_DECODE_BBLOCK until
+    measured on hardware (the recovery sweep carries it).
+    """
+    bbi = pl.program_id(0)
+    c = pl.program_id(1)
+    num_chunks = pl.num_programs(1)
+    hq, d = q_ref.shape[1], q_ref.shape[2]
+    hkv = k_ref.shape[2]
+    lens = jnp.stack([lengths_ref[bbi * bb + i] for i in range(bb)])  # [BB]
+    max_len = jnp.max(lens)
+    lo = jnp.maximum(lens - window, 0) if window > 0 else \
+        jnp.zeros_like(lens)
+    lo_min = jnp.min(lo)
+
+    @pl.when(c == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when((c * chunk < max_len) & ((c + 1) * chunk > lo_min))
+    def _accumulate():
+        q3 = (q_ref[:].astype(jnp.float32) * scale) \
+            .reshape(bb * hkv, groups, d)
+        k3 = k_ref[0].astype(jnp.float32).reshape(bb * hkv, chunk, d)
+        s = jax.lax.dot_general(
+            q3, k3, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)        # [BB*Hkv, G, C]
+        if quant:
+            s = s * ks_ref[0].reshape(bb * hkv, chunk)[:, None, :]
+        s = s.reshape(bb, hq, chunk)
+        col = c * chunk + jax.lax.broadcasted_iota(jnp.int32,
+                                                   (bb, hq, chunk), 2)
+        live = (col < lens[:, None, None]) & (col >= lo[:, None, None])
+        s = jnp.where(live, s, NEG_INF)
+        m_prev = m_ref[:, :, :1]
+        l_prev = l_ref[:, :, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_cur = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        v3 = v_ref[0].astype(jnp.float32).reshape(bb * hkv, chunk, d)
+        p3 = p.reshape(bb * hkv, groups, chunk)
+        if quant:
+            p3 = p3 * vs_ref[0].reshape(bb * hkv, chunk)[:, None, :]
+        pv = jax.lax.dot_general(
+            p3, v3, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)        # [BB*Hkv, G, D]
+        acc_ref[:] = acc_ref[:] * corr + pv.reshape(bb, hq, d)
+        m_ref[:, :, :1] = m_cur
+        l_ref[:, :, :1] = l_cur
+
+    @pl.when(c == num_chunks - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :, :1], 1e-9)
+        o_ref[:] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _decode_kernel_layer_q_bb(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
+                              ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref,
+                              *, chunk: int, groups: int, scale: float,
+                              bb: int, window: int = 0):
+    """Int8 batch-blocked variant: scale folding as in
+    _decode_kernel_layer_q, DMA batching as in _decode_kernel_layer_bb."""
+    _decode_kernel_layer_bb(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
+                            o_ref, acc_ref, m_ref, l_ref, chunk=chunk,
+                            groups=groups, scale=scale, bb=bb,
+                            window=window, quant=True, ks_ref=ks_ref,
+                            vs_ref=vs_ref)
+
+
 def _decode_kernel_layer_q_stats(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
                                  ks_ref, vs_ref, o_ref, mo_ref, lo_ref,
                                  acc_ref, m_ref, l_ref,
@@ -245,7 +339,7 @@ def _decode_kernel_layer_stats(lengths_ref, layer_ref, q_ref, k_ref, v_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("chunk", "interpret", "return_stats",
-                                    "window"))
+                                    "window", "bblock"))
 def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
                                cache_v: jnp.ndarray, lengths: jnp.ndarray,
                                layer: jnp.ndarray, chunk: int = 256,
@@ -253,7 +347,8 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
                                return_stats: bool = False,
                                cache_ks: jnp.ndarray = None,
                                cache_vs: jnp.ndarray = None,
-                               window: int = 0):
+                               window: int = 0,
+                               bblock: int = None):
     """Flash decode attention over ONE layer of the full stacked cache.
 
     q: [B, 1, Hq, D]; cache_k/v: [L, B, Hkv, S, D] (the whole cache buffer —
@@ -343,6 +438,71 @@ def decode_attend_pallas_layer(q: jnp.ndarray, cache_k: jnp.ndarray,
         )(lengths, layer_arr, *operands)
         # stats are replicated along the 128-lane axis; take lane 0
         return acc, m[:, :, 0], l[:, :, 0]
+    # Batch-blocking (PALLAS_DECODE_BBLOCK, default off): BB slots per grid
+    # step — BBx bigger DMAs, BB-fewer grid steps; see
+    # _decode_kernel_layer_bb for the measured rationale. Resolved to the
+    # largest divisor of B not exceeding the requested block.
+    bb = int(os.environ.get("PALLAS_DECODE_BBLOCK", "0") or 0) \
+        if bblock is None else bblock
+    bb = max(1, min(bb, B)) if bb else 1
+    while B % bb:
+        bb -= 1
+    if bb > 1:
+        def q_map_bb(g, c, lens, lay):
+            return (g, 0, 0)
+
+        def _clamped_bb(g, c, lens):
+            # the block's live range covers its LONGEST slot (and, with a
+            # window, its EARLIEST window start)
+            hi = jnp.int32(0)
+            lo_chunk = None
+            for i in range(bb):
+                ln = lens[g * bb + i]
+                hi = jnp.maximum(hi, pl.cdiv(ln, chunk) - 1)
+                if window > 0:
+                    lc = jnp.maximum(ln - window, 0) // chunk
+                    lo_chunk = lc if lo_chunk is None \
+                        else jnp.minimum(lo_chunk, lc)
+            hi = jnp.maximum(hi, 0)
+            if window > 0:
+                return jnp.clip(c, lo_chunk, hi)
+            return jnp.minimum(c, hi)
+
+        def kv_map_bb(g, c, lens, lay):
+            return (lay[0], g, 0, _clamped_bb(g, c, lens), 0)
+
+        def scale_map_bb(g, c, lens, lay):
+            return (lay[0], g, 0, _clamped_bb(g, c, lens))
+
+        in_specs_bb = [
+            pl.BlockSpec((bb, Hq, D), q_map_bb),
+            pl.BlockSpec((1, bb, Hkv, chunk, D), kv_map_bb),
+            pl.BlockSpec((1, bb, Hkv, chunk, D), kv_map_bb),
+        ]
+        if quant:
+            in_specs_bb += [pl.BlockSpec((1, bb, Hkv, chunk),
+                                         scale_map_bb)] * 2
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B // bb, num_chunks),
+            in_specs=in_specs_bb,
+            out_specs=pl.BlockSpec((bb, Hq, D), q_map_bb),
+            scratch_shapes=[
+                pltpu.VMEM((bb, Hq, D), jnp.float32),
+                pltpu.VMEM((bb, Hq, 128), jnp.float32),
+                pltpu.VMEM((bb, Hq, 128), jnp.float32),
+            ],
+        )
+        kernel = functools.partial(
+            _decode_kernel_layer_q_bb if quant else _decode_kernel_layer_bb,
+            chunk=chunk, groups=groups, scale=scale, bb=bb, window=window)
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+            interpret=interpret,
+        )(lengths, layer_arr, *operands)
+        return out[:, None]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, num_chunks),

@@ -2010,19 +2010,28 @@ class Engine:
                 self._spec_plain_due = bool(skip)
                 return
         self._spec_plain_due = False
-        # Guided decoding caps the PLAIN dispatch at horizon 1: the grammar
-        # mask is valid for ONE token (the host FSM must see token N before
-        # masking token N+1). Evaluated here — after the spec branch, so one
-        # guided request does NOT disable its neighbors' speculation (it
-        # rides the _slot_spec_ineligible skip set and advances on these
-        # alternating plain steps), and after _ensure_pages, whose
-        # preemption may have just cleared a guided slot (review r5: the
-        # pre-paged gslots list dereferenced slot_req[s] == None).
-        gslots = [s for s in active
-                  if self.slot_req[s] is not None
-                  and self.slot_req[s].guided is not None]
-        if gslots:
+        # Guided decoding: the grammar mask is valid for ONE token (the host
+        # FSM must see token N before masking token N+1), but capping the
+        # whole batch at horizon 1 would collapse every unguided neighbor to
+        # per-token dispatches (review r5: one response_format request would
+        # cost the batch ~an order of magnitude at the measured 89.5 ms
+        # dispatch RTT). Instead, MIXED batches keep the fused horizon and
+        # guided slots emit only substep 0's token — their surplus substeps
+        # sample against the (stale) mask and are discarded on the host,
+        # with the surplus K/V rows following the standard rewrite
+        # invariant. Pure-guided batches drop to horizon 1 for per-token
+        # latency. Evaluated after the spec branch (a guided request rides
+        # the _slot_spec_ineligible skip set, not an engine-wide disable)
+        # and after _ensure_pages, whose preemption may have just cleared a
+        # guided slot.
+        gset = frozenset(
+            s for s in active
+            if self.slot_req[s] is not None
+            and self.slot_req[s].guided is not None)
+        if gset and not any(self.slot_req[s] is not None and s not in gset
+                            for s in active):
             horizon = 1
+        gslots = list(gset)
         want_lp = self._want_logprobs(self.slot_req)
         want_pen = self.counts is not None and bool(
             self.pres_pens.any() or self.freq_pens.any()
@@ -2066,6 +2075,10 @@ class Engine:
             for slot in active:
                 if self.slot_req[slot] is None:
                     continue  # finished earlier in this horizon
+                if s > 0 and slot in gset:
+                    # guided slots advance one grammar-checked token per
+                    # dispatch; substeps past 0 are unconstrained surplus
+                    continue
                 req = self.slot_req[slot]
                 lp = None
                 if req.logprobs is not None and lp_t is not None:

@@ -42,6 +42,8 @@ raise ``ValueError`` → HTTP 400.
 from __future__ import annotations
 
 import json
+import re
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -441,13 +443,19 @@ def schema_to_rx(schema) -> tuple:
             for _, e in opt:
                 body = _seq(body, _opt(_seq(_RX_WS, _lit(b","), _RX_WS, e)))
         else:
-            # no required props: each optional in order, chained so commas
-            # stay valid (first present prop has no leading comma)
-            body = None
-            for _, e in opt:
-                body = e if body is None else \
-                    _seq(body, _opt(_seq(_RX_WS, _lit(b","), _RX_WS, e)))
-            body = _opt(body)
+            # no required props: any non-empty SUBSET in schema order must
+            # be reachable — alternate over which property appears FIRST,
+            # each later one an optional comma-group (review r5: a linear
+            # optional chain made the first property a prerequisite,
+            # e.g. '{"b": 1}' was unreachable beside '{"a": 1}')
+            alts = []
+            for i, (_, first) in enumerate(opt):
+                tail = first
+                for _, later in opt[i + 1:]:
+                    tail = _seq(tail, _opt(_seq(_RX_WS, _lit(b","),
+                                               _RX_WS, later)))
+                alts.append(tail)
+            body = _opt(_alt(*alts))
         return _seq(_lit(b"{"), _RX_WS, body, _RX_WS, _lit(b"}"))
     raise ValueError(f"unsupported schema type: {t!r}")
 
@@ -529,6 +537,7 @@ def token_byte_table(tokenizer) -> List[Optional[bytes]]:
         sample = [t for t in toks[:2000] if t]
         byte_level = sample and all(ch in uni2byte for t in sample[:50]
                                     for ch in t)
+        bytefb = re.compile(r"^<0x([0-9A-Fa-f]{2})>$")
         for i, t in enumerate(toks):
             if i in specials or not t:
                 continue
@@ -538,6 +547,13 @@ def token_byte_table(tokenizer) -> List[Optional[bytes]]:
                     continue
                 except KeyError:
                     pass
+            m = bytefb.match(t)
+            if m:
+                # sentencepiece byte-fallback: "<0x22>" DECODES to one raw
+                # byte — mapping the literal 6-char string would desync the
+                # FSM from the emitted text (review r5)
+                out[i] = bytes([int(m.group(1), 16)])
+                continue
             out[i] = t.replace("▁", " ").encode("utf-8")
         return out
     for i in range(V):                    # last-resort: lossy single decodes
@@ -577,10 +593,19 @@ class TokenGrammar:
                 self._tlen[i] = len(b)
                 self._no_bytes[i] = False
         self._tb = tb
+        # strong tokenizer ref: the grammar cache keys on id(tokenizer), so
+        # the tokenizer must outlive the grammar or a recycled address could
+        # alias a different vocab (review r5)
+        self._tokenizer = tokenizer
         self._ids: Dict[object, int] = {}
         self._by_id: List[object] = []
-        self._rows: Dict[int, np.ndarray] = {}
-        self._masks: Dict[int, np.ndarray] = {}
+        # bounded caches (review r5: json_object's stack-state space grows
+        # with client-controlled nesting; unbounded per-state masks at
+        # ~V/8 bytes each would leak for the server's lifetime)
+        self._rows: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self._masks: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self._rows_cap = 8192
+        self._masks_cap = 2048
         # whitespace token ids: allowed in accepting states alongside eos so
         # a min_tokens-banned eos can never leave an all-masked row
         self._ws_ids = [i for i, b in enumerate(tb)
@@ -605,6 +630,10 @@ class TokenGrammar:
                 if nxt is not None:
                     row[c] = self._sid(nxt)
             self._rows[sid] = row
+            if len(self._rows) > self._rows_cap:
+                self._rows.popitem(last=False)
+        else:
+            self._rows.move_to_end(sid)
         return row
 
     def accepting(self, sid: int) -> bool:
@@ -627,6 +656,7 @@ class TokenGrammar:
         """Packed uint32 allow-bitmask for machine state ``sid``."""
         m = self._masks.get(sid)
         if m is not None:
+            self._masks.move_to_end(sid)
             return m
         V = self.vocab_size
         cur = np.full(V, sid, np.int64)
@@ -658,6 +688,8 @@ class TokenGrammar:
         np.bitwise_or.at(words, idx >> 5,
                          (np.uint32(1) << (idx & 31).astype(np.uint32)))
         self._masks[sid] = words
+        if len(self._masks) > self._masks_cap:
+            self._masks.popitem(last=False)
         return words
 
 

@@ -868,8 +868,25 @@ def build_state(serving_cfg=None, model_cfg=None, params=None,
                         "dry-run/benchmark mode only", model_cfg.name)
             params = init_params(model_cfg, jax.random.PRNGKey(0), dtype)
 
+    draft = None
+    if serving.spec_decode and serving.spec_method == "draft":
+        if not serving.draft_checkpoint_dir:
+            raise ValueError("spec_method='draft' requires "
+                             "--draft-checkpoint-dir")
+        from aws_k8s_ansible_provisioner_tpu.models.checkpoint import (
+            load_checkpoint_cached)
+
+        draft_cfg = config_from_hf_dir(serving.draft_checkpoint_dir)
+        # the draft is small by design: load unsharded (serving/draft.py
+        # runs it replicated beside the sharded target)
+        draft_params = load_checkpoint_cached(serving.draft_checkpoint_dir,
+                                              draft_cfg, dtype, mesh=None)
+        draft = (draft_cfg, draft_params)
+        log.info("draft model: %s (%s)", draft_cfg.name,
+                 serving.draft_checkpoint_dir)
     engine = Engine(model_cfg, params, serving,
-                    eos_token_id=tokenizer.eos_token_id, mesh=mesh)
+                    eos_token_id=tokenizer.eos_token_id, mesh=mesh,
+                    draft=draft)
     templater = ChatTemplater(model_cfg.name, tokenizer,
                               template_path=serving.chat_template or None)
     return ServerState(engine, tokenizer, templater, serving.model)
@@ -960,6 +977,13 @@ def main(argv=None):
                         "pure-tp meshes)")
     p.add_argument("--spec-k", type=int, default=4,
                    help="draft tokens per speculative step")
+    p.add_argument("--spec-method", default="prompt_lookup",
+                   choices=["prompt_lookup", "draft"],
+                   help="proposal source: context n-gram matching, or a "
+                        "small draft LM (--draft-checkpoint-dir)")
+    p.add_argument("--draft-checkpoint-dir", default="",
+                   help="HF checkpoint dir of the draft model "
+                        "(spec_method=draft)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
@@ -1002,6 +1026,8 @@ def main(argv=None):
         prefill_chunk=args.prefill_chunk,
         prefix_cache=not args.no_prefix_cache,
         spec_decode=args.spec_decode, spec_k=args.spec_k,
+        spec_method=args.spec_method,
+        draft_checkpoint_dir=args.draft_checkpoint_dir,
         mesh=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep))
     state = build_state(serving)
     if not args.no_warmup:

@@ -171,6 +171,11 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default="",
                    help="force a JAX platform (e.g. cpu for dry-run)")
+    p.add_argument("--data", nargs="*", default=[],
+                   help="text/.jsonl corpus files (training/data.py packed "
+                        "stream); omitted = synthetic random tokens")
+    p.add_argument("--tokenizer-dir", default="",
+                   help="HF tokenizer dir for --data (default: byte-level)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
@@ -180,10 +185,21 @@ def main(argv=None):
     # in the registry (a typo must not silently train the miniature model)
     cfg = tiny_qwen3() if args.model == "tiny-qwen3" \
         else get_model_config(args.model)
+    data_fn = None
+    if args.data:
+        from aws_k8s_ansible_provisioner_tpu.training.data import text_data_fn
+        from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import (
+            load_tokenizer)
+
+        tok = load_tokenizer(args.tokenizer_dir or None)
+        data_fn = text_data_fn(args.data, tok, args.batch, args.seq_len)
+        log.info("packed corpus: %d tokens/epoch from %d file(s)",
+                 data_fn.tokens_per_epoch, len(args.data))
     state = train(cfg, MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp),
                   optax.adamw(args.lr), steps=args.steps, batch=args.batch,
                   seq_len=args.seq_len, ckpt_dir=args.ckpt_dir,
-                  ckpt_every=args.ckpt_every, seed=args.seed)
+                  ckpt_every=args.ckpt_every, seed=args.seed,
+                  data_fn=data_fn)
     log.info("done at step %d", int(state.step))
 
 

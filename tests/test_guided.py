@@ -458,3 +458,107 @@ def test_guided_neighbor_does_not_disable_spec():
     assert len(looper.generated) == 20
     assert eng.metrics.spec_drafted_tokens.total() > 0, \
         "guided neighbor must not disable speculation batch-wide"
+
+
+def test_token_byte_table_real_byte_level_bpe():
+    """Guided decoding on a REAL byte-level BPE tokenizer (the Qwen/Llama-3
+    vocab encoding): token_byte_table must invert the GPT-2 unicode-stand-in
+    mapping exactly, multi-byte tokens must advance the machine through all
+    their bytes, and masks must allow multi-char tokens like '{\"'."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from transformers import PreTrainedTokenizerFast
+
+    from aws_k8s_ansible_provisioner_tpu.serving.guided import (
+        token_byte_table)
+
+    # GPT-2 byte alphabet: every byte as its printable stand-in character
+    bs = list(range(0x21, 0x7F)) + list(range(0xA1, 0xAD)) + \
+        list(range(0xAE, 0x100))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    byte2uni = {b: chr(c) for b, c in zip(bs, cs)}
+    singles = [byte2uni[b] for b in range(256)]
+    vocab = {ch: i for i, ch in enumerate(singles)}
+    # a few multi-char merges incl. the JSON-relevant '{"'
+    merges = []
+    for pair in [('{', '"'), ('"', ':'), ('t', 'r'), ('tr', 'u')]:
+        merged = pair[0] + pair[1]
+        vocab[merged] = len(vocab)
+        merges.append(pair)
+    tk = tokenizers.Tokenizer(tokenizers.models.BPE(vocab=vocab,
+                                                    merges=merges))
+    tk.pre_tokenizer = tokenizers.pre_tokenizers.ByteLevel(
+        add_prefix_space=False)
+    tk.decoder = tokenizers.decoders.ByteLevel()
+    fast = PreTrainedTokenizerFast(tokenizer_object=tk)
+
+    class Wrap:
+        _tok = fast
+        vocab_size = len(fast)
+        eos_token_id = None
+
+    tb = token_byte_table(Wrap())
+    assert tb[vocab['{']] == b"{"
+    assert tb[vocab['{"']] == b'{"'
+    assert tb[vocab[byte2uni[0x20]]] == b" "       # space stand-in inverts
+    assert tb[vocab[byte2uni[0xE2]]] == b"\xe2"    # raw high byte inverts
+
+    g = TokenGrammar(JsonMachine(top="object"), Wrap(), [])
+    gs = GuidedState(g)
+    w = gs.mask_words()
+    def allowed(tid):
+        return bool((w[tid >> 5] >> (tid & 31)) & 1)
+    assert allowed(vocab['{'])
+    assert allowed(vocab['{"'])                    # multi-byte walk survives
+    assert not allowed(vocab['"'])                 # '"' can't start an object
+    gs.advance(vocab['{"'])                        # advances TWO bytes
+    assert not gs.dead
+    w2 = gs.mask_words()
+    # inside a key string now: '"' (close) allowed, '{' not
+    assert bool((w2[vocab['"'] >> 5] >> (vocab['"'] & 31)) & 1)
+
+
+def test_schema_all_optional_any_subset_reachable():
+    """required: [] must allow ANY non-empty subset in schema order (review
+    r5: the linear optional chain made the first property a prerequisite)."""
+    s = {"type": "object",
+         "properties": {"a": {"type": "integer"}, "b": {"type": "integer"},
+                        "c": {"type": "integer"}},
+         "required": []}
+    m = NfaMachine(schema_to_rx(s))
+    for ok in ('{}', '{"a": 1}', '{"b": 2}', '{"c": 3}', '{"a": 1, "c": 3}',
+               '{"b": 2, "c": 3}', '{"a": 1, "b": 2, "c": 3}'):
+        assert _accepts(m, ok), ok
+    for bad in ('{"b": 2, "a": 1}', '{"a": 1,}'):
+        assert not _accepts(m, bad), bad
+
+
+def test_token_byte_table_sentencepiece_byte_fallback():
+    """SP byte-fallback tokens ('<0x22>') decode to ONE raw byte; the table
+    must map them so (review r5: the literal 6-char string desynced the FSM
+    from the emitted text on Llama/Mistral/Gemma-class tokenizers)."""
+    from aws_k8s_ansible_provisioner_tpu.serving.guided import (
+        token_byte_table)
+
+    class FakeSP:
+        class _tok:
+            all_special_ids = [0]
+
+            @staticmethod
+            def convert_ids_to_tokens(ids):
+                return ["<s>", "▁the", "<0x22>", "<0x0A>", "x"][:len(ids)]
+
+        vocab_size = 5
+        eos_token_id = 0
+
+    tb = token_byte_table(FakeSP())
+    assert tb[0] is None                  # special stays banned
+    assert tb[1] == b" the"
+    assert tb[2] == b'"'
+    assert tb[3] == b"\n"
+    assert tb[4] == b"x"

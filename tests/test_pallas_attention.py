@@ -182,3 +182,80 @@ def test_cache_write_row_drops_out_of_window_rows():
                                np.asarray(knew[1]))
     np.testing.assert_array_equal(np.asarray(out[:, 2]),    # dropped (neg)
                                   np.asarray(ck[:, 2]))
+
+
+# ---------------------------------------------------------------------------
+# Batch-blocked decode (PALLAS_DECODE_BBLOCK — round 5 grid-overhead lever)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("bb", [2, 4])
+@pytest.mark.parametrize("chunk", [32, 64])
+def test_bblock_parity_vs_unblocked(bb, chunk):
+    """BB slots per grid step must be bit-equal (fp32 tol) to the per-slot
+    kernel across ragged lengths — incl. blocks mixing long and short slots
+    (the conservative max-length clamp must not leak dead rows)."""
+    from aws_k8s_ansible_provisioner_tpu.ops.pallas_attention import (
+        decode_attend_pallas_layer)
+
+    q, k, v, _ = _inputs(B=8, S=128)
+    lengths = jnp.asarray([1, 128, 7, 64, 33, 97, 2, 128], jnp.int32)
+    ck, cv = k[None], v[None]
+    ref = decode_attend_pallas_layer(q, ck, cv, lengths, jnp.int32(0),
+                                     chunk=chunk, interpret=True)
+    got = decode_attend_pallas_layer(q, ck, cv, lengths, jnp.int32(0),
+                                     chunk=chunk, interpret=True, bblock=bb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bblock_parity_quant():
+    from aws_k8s_ansible_provisioner_tpu.ops.pallas_attention import (
+        decode_attend_pallas_layer)
+    from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
+
+    q, k, v, _ = _inputs(B=8, S=128)
+    lengths = jnp.asarray([5, 128, 70, 1, 99, 128, 13, 40], jnp.int32)
+    kq, ks = kvc.quantize_rows(k[None])
+    vq, vs = kvc.quantize_rows(v[None])
+    ref = decode_attend_pallas_layer(q, kq, vq, lengths, jnp.int32(0),
+                                     chunk=64, interpret=True,
+                                     cache_ks=ks, cache_vs=vs)
+    got = decode_attend_pallas_layer(q, kq, vq, lengths, jnp.int32(0),
+                                     chunk=64, interpret=True,
+                                     cache_ks=ks, cache_vs=vs, bblock=4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bblock_parity_sliding_window():
+    from aws_k8s_ansible_provisioner_tpu.ops.pallas_attention import (
+        decode_attend_pallas_layer)
+
+    q, k, v, _ = _inputs(B=4, S=128)
+    lengths = jnp.asarray([20, 128, 64, 100], jnp.int32)
+    ref = decode_attend_pallas_layer(q, k[None], v[None], lengths,
+                                     jnp.int32(0), chunk=32, interpret=True,
+                                     window=48)
+    got = decode_attend_pallas_layer(q, k[None], v[None], lengths,
+                                     jnp.int32(0), chunk=32, interpret=True,
+                                     window=48, bblock=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bblock_non_divisible_batch_shrinks():
+    """bblock larger than a divisor of B must fall back to the largest
+    divisor, never crash or misindex."""
+    from aws_k8s_ansible_provisioner_tpu.ops.pallas_attention import (
+        decode_attend_pallas_layer)
+
+    q, k, v, _ = _inputs(B=6, S=64)
+    lengths = jnp.asarray([3, 64, 17, 50, 1, 64], jnp.int32)
+    ref = decode_attend_pallas_layer(q, k[None], v[None], lengths,
+                                     jnp.int32(0), chunk=32, interpret=True)
+    got = decode_attend_pallas_layer(q, k[None], v[None], lengths,
+                                     jnp.int32(0), chunk=32, interpret=True,
+                                     bblock=4)   # 6 % 4 != 0 -> bb=3
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

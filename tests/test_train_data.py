@@ -1,0 +1,96 @@
+"""Real-corpus training data path (training/data.py; VERDICT r4 weak #7).
+
+The properties that matter: deterministic resume (data(step) is a pure
+function of corpus + step), exact packing (every corpus token appears, in
+order, documents eos-delimited), dp sharding that partitions the global
+batch, and the end-to-end proof — the train loop LEARNS a real repetitive
+corpus (loss drops), which the synthetic random stream can never show.
+"""
+
+import numpy as np
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.training.data import (PackedCorpus,
+                                                           text_data_fn,
+                                                           tokenize_files)
+from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+
+def test_tokenize_files_text_and_jsonl(tmp_path):
+    (tmp_path / "a.txt").write_text("ab")
+    (tmp_path / "b.jsonl").write_text('{"text": "cd"}\n{"text": "e"}\n')
+    tok = ByteTokenizer()
+    stream = tokenize_files([str(tmp_path / "a.txt"),
+                             str(tmp_path / "b.jsonl")], tok)
+    eos = tok.eos_token_id
+    assert stream.tolist() == [ord("a"), ord("b"), eos,
+                               ord("c"), ord("d"), eos, ord("e"), eos]
+
+
+def test_packed_batches_cover_stream_in_order():
+    stream = np.arange(100, dtype=np.int32)
+    corpus = PackedCorpus(stream, batch=2, seq_len=10)
+    t0, m0 = corpus(0)
+    assert t0.shape == (2, 10) and m0.all()
+    assert t0[0].tolist() == list(range(0, 10))
+    assert t0[1].tolist() == list(range(10, 20))
+    t1, _ = corpus(1)
+    assert t1[0].tolist() == list(range(20, 30))
+
+
+def test_wraparound_short_corpus():
+    stream = np.arange(7, dtype=np.int32)
+    corpus = PackedCorpus(stream, batch=1, seq_len=5)
+    t1, _ = corpus(1)               # starts at position 5, wraps at 7
+    assert t1[0].tolist() == [5, 6, 0, 1, 2]
+
+
+def test_determinism_is_resume_safe():
+    stream = np.arange(512, dtype=np.int32)
+    a = PackedCorpus(stream, batch=4, seq_len=16)
+    b = PackedCorpus(stream, batch=4, seq_len=16)   # "restarted process"
+    for step in (0, 3, 7):
+        np.testing.assert_array_equal(a(step)[0], b(step)[0])
+
+
+def test_dp_sharding_partitions_global_batch():
+    stream = np.arange(4096, dtype=np.int32)
+    full = PackedCorpus(stream, batch=4, seq_len=8)
+    shards = [PackedCorpus(stream, batch=4, seq_len=8, dp_rank=r, dp_size=2)
+              for r in range(2)]
+    ref, _ = full(5)
+    got0, _ = shards[0](5)
+    got1, _ = shards[1](5)
+    np.testing.assert_array_equal(ref[0::2], got0)
+    np.testing.assert_array_equal(ref[1::2], got1)
+    with pytest.raises(ValueError, match="divisible"):
+        PackedCorpus(stream, batch=3, seq_len=8, dp_size=2)
+
+
+def test_train_loop_learns_real_corpus(tmp_path):
+    """End-to-end: a tiny model on a repetitive real corpus must drive the
+    loss well below its starting point — the integration proof the
+    synthetic path can't give."""
+    import jax
+    import optax
+
+    from aws_k8s_ansible_provisioner_tpu.config import MeshConfig, tiny_qwen3
+    from aws_k8s_ansible_provisioner_tpu.parallel import make_mesh
+    from aws_k8s_ansible_provisioner_tpu.training.loop import init_train_state
+    from aws_k8s_ansible_provisioner_tpu.training.trainer import (
+        make_train_step)
+
+    (tmp_path / "corpus.txt").write_text("the cat sat on the mat. " * 40)
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
+    batch, seq_len = 4, 32
+    data = text_data_fn(str(tmp_path / "corpus.txt"), tok, batch, seq_len)
+    mesh = make_mesh(MeshConfig())
+    state = init_train_state(cfg, mesh, optax.adamw(3e-3), seed=0)
+    step_fn = make_train_step(cfg, mesh, optax.adamw(3e-3))
+    losses = []
+    for s in range(30):
+        tokens, mask = data(s)
+        state, loss = step_fn(state, tokens, mask)
+        losses.append(float(loss))
+    assert losses[-1] < 0.5 * losses[0], losses[::10]
