@@ -24,6 +24,7 @@ run() {
     fi
     echo "--- $label done" >&2
 }
+run paged_carry    TPU_BENCH_PAGED=1
 run bb8_b128       TPU_BENCH_PAGED=0 PALLAS_DECODE_BBLOCK=8
 run bb16_b128      TPU_BENCH_PAGED=0 PALLAS_DECODE_BBLOCK=16
 run paged_b64      TPU_BENCH_PAGED=1 TPU_BENCH_BATCH=64
@@ -31,5 +32,6 @@ run w8_bb8_b128    TPU_BENCH_PAGED=0 PALLAS_DECODE_BBLOCK=8 TPU_BENCH_WEIGHTS=in
 run dense_b192_bb8 TPU_BENCH_PAGED=0 TPU_BENCH_BATCH=192 PALLAS_DECODE_BBLOCK=8
 run dense_h128     TPU_BENCH_PAGED=0 TPU_BENCH_BATCH=128 TPU_BENCH_HORIZON=128 PALLAS_DECODE_BBLOCK=8
 run w8_b128        TPU_BENCH_PAGED=0 TPU_BENCH_WEIGHTS=int8
+run paged_ps256    TPU_BENCH_PAGED=1 TPU_BENCH_PAGE_SIZE=256
 run paged_b96      TPU_BENCH_PAGED=1 TPU_BENCH_BATCH=96
 echo "SWEEP COMPLETE" >&2

@@ -630,64 +630,71 @@ def make_spec_attend_carry_paged(lengths: jnp.ndarray, table: jnp.ndarray,
         return ctx, (pool, layer)
 
     return attend
-
-
-def make_prefill_attend_paged(pages: jnp.ndarray, seq_len: jnp.ndarray,
-                              window: int = 0):
-    """Paged single-sequence prefill: causal attention + page-scattered
-    write (paged_kv.write_prompt_paged). ``pages`` [max_pages] int32."""
-    from aws_k8s_ansible_provisioner_tpu.models.layers import causal_attend
-
-    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
-        from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
-
-        ps = cache_l["k"].shape[2]
-        ctx = causal_attend(q, k, v, seq_lens=seq_len[None], window=window)
-        cache_l = pkv.write_prompt_paged(cache_l, pages, k, v, ps)
-        return ctx, cache_l
-
-    return attend
-
-
-def make_prefill_attend_batch_paged(tables: jnp.ndarray,
-                                    seq_lens: jnp.ndarray, window: int = 0):
-    """Paged batched prefill: N prompts scattered to their pages in one
-    dispatch. Padding rows carry all-OOB_PAGE tables (writes drop) — NEVER
-    -1, which jnp scatters wrap to the pool's last physical page
-    (paged_kv.OOB_PAGE's contract)."""
-    from aws_k8s_ansible_provisioner_tpu.models.layers import causal_attend
-
-    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
-        from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
-
-        ps = cache_l["k"].shape[2]
-        ctx = causal_attend(q, k, v, seq_lens=seq_lens, window=window)
-        cache_l = pkv.write_prompts_paged(cache_l, tables, k, v, ps)
-        return ctx, cache_l
-
-    return attend
-
-
-def make_chunk_prefill_attend_paged(pages: jnp.ndarray, start: jnp.ndarray,
+def make_prefill_attend_paged_carry(pages: jnp.ndarray, seq_len: jnp.ndarray,
                                     window: int = 0):
-    """Paged chunked prefill: write the chunk's rows across pages, then
-    attend the chunk queries over the slot's gathered page prefix. The
-    gather materializes one slot's logical view per layer — a prefill-only
-    cost, amortized over the chunk's tokens (decode never gathers)."""
+    """CARRY-path paged single-prompt prefill: the full pool rides the layer
+    scan's carry (in place via loop aliasing) instead of xs→ys, whose
+    restack buffer OOMed the batch-128 paged program on the real chip
+    (round 5; see paged_kv.write_prompts_paged_layer)."""
+    from aws_k8s_ansible_provisioner_tpu.models.layers import causal_attend
 
-    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, dict]:
+    def attend(q, k, v, cache_l):
         from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
 
-        ps = cache_l["k"].shape[2]
-        cache_l = pkv.write_chunk_paged(cache_l, pages, start, k, v, ps)
-        ck = pkv.gather_slot(cache_l, pages, ps, "k")
-        cv = pkv.gather_slot(cache_l, pages, ps, "v")
-        if "ks" in cache_l:
-            ck = kvc.dequantize(ck, pkv.gather_slot(cache_l, pages, ps, "ks"),
-                                dtype=q.dtype)
-            cv = kvc.dequantize(cv, pkv.gather_slot(cache_l, pages, ps, "vs"),
-                                dtype=q.dtype)
+        cache, layer = cache_l
+        ps = cache["k"].shape[3]
+        ctx = causal_attend(q, k, v, seq_lens=seq_len[None], window=window)
+        cache = pkv.write_chunk_paged_layer(cache, layer, pages,
+                                            jnp.int32(0), k, v, ps)
+        return ctx, (cache, layer)
+
+    return attend
+
+
+def make_prefill_attend_batch_paged_carry(tables: jnp.ndarray,
+                                          seq_lens: jnp.ndarray,
+                                          window: int = 0):
+    """CARRY-path paged batched prefill (see make_prefill_attend_paged_carry
+    for the memory rationale). Padding rows carry all-OOB_PAGE tables."""
+    from aws_k8s_ansible_provisioner_tpu.models.layers import causal_attend
+
+    def attend(q, k, v, cache_l):
+        from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
+
+        cache, layer = cache_l
+        ps = cache["k"].shape[3]
+        ctx = causal_attend(q, k, v, seq_lens=seq_lens, window=window)
+        cache = pkv.write_prompts_paged_layer(cache, layer, tables, k, v, ps)
+        return ctx, (cache, layer)
+
+    return attend
+
+
+def make_chunk_prefill_attend_paged_carry(pages: jnp.ndarray, start,
+                                          window: int = 0):
+    """CARRY-path paged chunked prefill: write the chunk's rows through the
+    full-pool scatter, then attend over the slot's gathered page prefix
+    (the gather materializes ONE slot's view per layer — a prefill-only
+    cost, exactly as the xs/ys form paid)."""
+
+    def attend(q, k, v, cache_l):
+        from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
+
+        cache, layer = cache_l
+        ps = cache["k"].shape[3]
+        cache = pkv.write_chunk_paged_layer(cache, layer, pages, start,
+                                            k, v, ps)
+
+        def gl(name):
+            sl = jax.lax.dynamic_index_in_dim(cache[name], layer, 0,
+                                              keepdims=False)
+            return pkv.gather_slot({name: sl}, pages, ps, name)
+
+        ck, cv = gl("k"), gl("v")
+        if "ks" in cache:
+            ck = kvc.dequantize(ck, gl("ks"), dtype=q.dtype)
+            cv = kvc.dequantize(cv, gl("vs"), dtype=q.dtype)
         ctx = chunk_attend(q, ck, cv, start, window=window)
-        return ctx, cache_l
+        return ctx, (cache, layer)
 
     return attend

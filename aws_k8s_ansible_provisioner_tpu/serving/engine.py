@@ -46,13 +46,13 @@ from aws_k8s_ansible_provisioner_tpu.models.layers import (
 )
 from aws_k8s_ansible_provisioner_tpu.ops.attention import (
     make_chunk_prefill_attend,
-    make_chunk_prefill_attend_paged,
+    make_chunk_prefill_attend_paged_carry,
     make_decode_attend_carry,
     make_decode_attend_carry_paged,
     make_prefill_attend,
     make_prefill_attend_batch,
-    make_prefill_attend_batch_paged,
-    make_prefill_attend_paged,
+    make_prefill_attend_batch_paged_carry,
+    make_prefill_attend_paged_carry,
     make_spec_attend_carry,
     make_spec_attend_carry_paged,
 )
@@ -305,12 +305,17 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
     T = tokens.shape[1]
     positions = jnp.arange(T, dtype=jnp.int32)[None, :]
     if pages is not None:
-        attend = make_prefill_attend_paged(pages, true_len,
-                                           window=cfg.sliding_window)
+        # carry path: the pool stays in the layer scan's carry — the xs→ys
+        # restack buffer OOMed the batch-128 paged program on chip (r5)
+        attend = make_prefill_attend_paged_carry(pages, true_len,
+                                                 window=cfg.sliding_window)
+        logits, cache = model_forward_carry(params, cfg, tokens, positions,
+                                            cache, attend)
     else:
         attend = make_prefill_attend(slot, true_len,
                                      window=cfg.sliding_window)
-    logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
+        logits, cache = model_forward(params, cfg, tokens, positions, cache,
+                                      attend)
     last = jnp.take(logits[0], true_len - 1, axis=0)[None]   # [1, V]
     last = _apply_prefill_repetition(last, tokens, true_len[None],
                                      rep[None] if rep is not None else None)
@@ -352,12 +357,16 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
     N, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (N, T))
     if tables is not None:
-        attend = make_prefill_attend_batch_paged(tables, true_lens,
-                                                 window=cfg.sliding_window)
+        # carry path — see prefill_step's paged branch
+        attend = make_prefill_attend_batch_paged_carry(
+            tables, true_lens, window=cfg.sliding_window)
+        logits, cache = model_forward_carry(params, cfg, tokens, positions,
+                                            cache, attend)
     else:
         attend = make_prefill_attend_batch(slots, true_lens,
                                            window=cfg.sliding_window)
-    logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
+        logits, cache = model_forward(params, cfg, tokens, positions, cache,
+                                      attend)
     last = logits[jnp.arange(N), true_lens - 1]            # [N, V]
     last = _apply_prefill_repetition(last, tokens, true_lens, reps)
     if bias_ids is not None:
@@ -393,12 +402,16 @@ def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
     C = tokens.shape[1]
     positions = start + jnp.arange(C, dtype=jnp.int32)[None, :]
     if pages is not None:
-        attend = make_chunk_prefill_attend_paged(pages, start,
-                                                 window=cfg.sliding_window)
+        # carry path — see prefill_step's paged branch
+        attend = make_chunk_prefill_attend_paged_carry(
+            pages, start, window=cfg.sliding_window)
+        logits, cache = model_forward_carry(params, cfg, tokens, positions,
+                                            cache, attend)
     else:
         attend = make_chunk_prefill_attend(slot, start,
                                            window=cfg.sliding_window)
-    logits, cache = model_forward(params, cfg, tokens, positions, cache, attend)
+        logits, cache = model_forward(params, cfg, tokens, positions, cache,
+                                      attend)
     last = jnp.take(logits[0], chunk_len - 1, axis=0)[None]  # [1, V]
     if rep is not None and rep_seen is not None:
         # chunks only carry a slice of the prompt: the seen-set over the
@@ -484,8 +497,11 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
         # exactly when vLLM's would.
         step_logits = _apply_logit_bias(step_logits, bias_ids, bias_vals)
         step_logits = _mask_banned(step_logits, ban_ids, ban_until, lens)
-        # Guided mask is computed for THIS step's state only, so the engine
-        # dispatches guided traffic at horizon 1 (see _do_decode).
+        # Guided mask is computed for substep 0's state only: in mixed
+        # batches the host emits just that substep for guided slots and
+        # discards the rest (penalized guided slots force horizon 1 so the
+        # per-substep count updates above never cover discarded tokens —
+        # see _do_decode).
         step_logits = _apply_allow(step_logits, allow)
         # ctr = lens + 1 = the context length this draw extends TO: distinct
         # from the prefill draw's ctr (= prompt length) and equal to what a
@@ -2030,6 +2046,14 @@ class Engine:
             and self.slot_req[s].guided is not None)
         if gset and not any(self.slot_req[s] is not None and s not in gset
                             for s in active):
+            horizon = 1
+        elif gset and self.counts is not None and any(
+                self.pres_pens[s] or self.freq_pens[s]
+                or self.rep_pens[s] != 1.0 for s in gset):
+            # a penalized guided slot cannot ride the mixed fused horizon:
+            # the device increments its penalty-count row for EVERY substep,
+            # but the host discards its surplus tokens — phantom counts
+            # would silently skew its penalties (review r5)
             horizon = 1
         gslots = list(gset)
         want_lp = self._want_logprobs(self.slot_req)

@@ -597,69 +597,84 @@ class TokenGrammar:
         # the tokenizer must outlive the grammar or a recycled address could
         # alias a different vocab (review r5)
         self._tokenizer = tokenizer
-        self._ids: Dict[object, int] = {}
-        self._by_id: List[object] = []
-        # bounded caches (review r5: json_object's stack-state space grows
-        # with client-controlled nesting; unbounded per-state masks at
-        # ~V/8 bytes each would leak for the server's lifetime)
-        self._rows: "OrderedDict[int, np.ndarray]" = OrderedDict()
-        self._masks: "OrderedDict[int, np.ndarray]" = OrderedDict()
-        self._rows_cap = 8192
+        # BOUNDED caches keyed by the (hashable) machine STATE itself —
+        # review r5 twice over: per-state masks at ~V/8 bytes leak for the
+        # server's lifetime unbounded, and an earlier fix that LRU'd masks
+        # but permanently interned every state in an id table just moved
+        # the leak down a level. No global interning exists now; evicted
+        # entries recompute from the state object, so eviction can never
+        # invalidate a live request's cursor.
+        self._rows: "OrderedDict[object, tuple]" = OrderedDict()
+        self._masks: "OrderedDict[object, np.ndarray]" = OrderedDict()
+        self._rows_cap = 1024
         self._masks_cap = 2048
         # whitespace token ids: allowed in accepting states alongside eos so
         # a min_tokens-banned eos can never leave an all-masked row
         self._ws_ids = [i for i, b in enumerate(tb)
                         if b and all(c in _WS for c in b)]
-        self.start_sid = self._sid(machine.start())
+        self.start_state = machine.start()
 
-    def _sid(self, st) -> int:
-        sid = self._ids.get(st)
-        if sid is None:
-            sid = len(self._by_id)
-            self._ids[st] = sid
-            self._by_id.append(st)
-        return sid
-
-    def _row(self, sid: int) -> np.ndarray:
-        row = self._rows.get(sid)
+    def _row(self, st) -> tuple:
+        """256-entry tuple of next states (None = reject) for ``st``."""
+        row = self._rows.get(st)
         if row is None:
-            st = self._by_id[sid]
-            row = np.full(256, -1, np.int32)
-            for c in range(256):
-                nxt = self._m.step(st, c)
-                if nxt is not None:
-                    row[c] = self._sid(nxt)
-            self._rows[sid] = row
+            row = tuple(self._m.step(st, c) for c in range(256))
+            self._rows[st] = row
             if len(self._rows) > self._rows_cap:
                 self._rows.popitem(last=False)
         else:
-            self._rows.move_to_end(sid)
+            self._rows.move_to_end(st)
         return row
 
-    def accepting(self, sid: int) -> bool:
-        return self._m.accepting(self._by_id[sid])
+    def accepting(self, st) -> bool:
+        return self._m.accepting(st)
 
-    def advance(self, sid: int, token_id: int) -> int:
-        """New state id after emitting ``token_id``; -1 = rejected."""
+    def advance(self, st, token_id: int):
+        """State after emitting ``token_id``; None = rejected."""
         if token_id in self._eos:
-            return sid if self.accepting(sid) else -1
+            return st if self.accepting(st) else None
         if token_id >= self.vocab_size or self._no_bytes[token_id]:
-            return -1
+            return None
         for c in self._tbmat[token_id, :self._tlen[token_id]]:
-            row = self._row(sid)
-            sid = int(row[c])
-            if sid < 0:
-                return -1
-        return sid
+            st = self._row(st)[c]
+            if st is None:
+                return None
+        return st
 
-    def mask_words(self, sid: int) -> np.ndarray:
-        """Packed uint32 allow-bitmask for machine state ``sid``."""
-        m = self._masks.get(sid)
+    def mask_words(self, st) -> np.ndarray:
+        """Packed uint32 allow-bitmask for machine state ``st``.
+
+        The vocab walk vectorizes with WALK-LOCAL state ids (a dict built
+        per computation) — nothing outlives the call except the LRU'd
+        result."""
+        m = self._masks.get(st)
         if m is not None:
-            self._masks.move_to_end(sid)
+            self._masks.move_to_end(st)
             return m
         V = self.vocab_size
-        cur = np.full(V, sid, np.int64)
+        local: Dict[object, int] = {st: 0}
+        states: List[object] = [st]
+
+        def lid(s) -> int:
+            i = local.get(s)
+            if i is None:
+                i = len(states)
+                local[s] = i
+                states.append(s)
+            return i
+
+        row_ids_memo: Dict[int, np.ndarray] = {}
+
+        def row_ids(u: int) -> np.ndarray:
+            r = row_ids_memo.get(u)
+            if r is None:
+                r = np.fromiter(
+                    (-1 if s is None else lid(s)
+                     for s in self._row(states[u])), np.int64, 256)
+                row_ids_memo[u] = r
+            return r
+
+        cur = np.zeros(V, np.int64)
         cur[self._no_bytes] = -1
         for p in range(self._tbmat.shape[1]):
             act = (p < self._tlen) & (cur >= 0)
@@ -667,12 +682,11 @@ class TokenGrammar:
                 break
             nxt = cur.copy()
             for u in np.unique(cur[act]):
-                row = self._row(int(u))
                 sel = act & (cur == u)
-                nxt[sel] = row[self._tbmat[sel, p]]
+                nxt[sel] = row_ids(int(u))[self._tbmat[sel, p]]
             cur = nxt
         allowed = cur >= 0
-        if self.accepting(sid):
+        if self.accepting(st):
             for e in self._eos:
                 if e < V:
                     allowed[e] = True
@@ -687,7 +701,7 @@ class TokenGrammar:
         idx = np.nonzero(allowed)[0]
         np.bitwise_or.at(words, idx >> 5,
                          (np.uint32(1) << (idx & 31).astype(np.uint32)))
-        self._masks[sid] = words
+        self._masks[st] = words
         if len(self._masks) > self._masks_cap:
             self._masks.popitem(last=False)
         return words
@@ -696,11 +710,11 @@ class TokenGrammar:
 class GuidedState:
     """Per-request cursor over a shared TokenGrammar."""
 
-    __slots__ = ("grammar", "sid", "dead")
+    __slots__ = ("grammar", "state", "dead")
 
     def __init__(self, grammar: TokenGrammar):
         self.grammar = grammar
-        self.sid = grammar.start_sid
+        self.state = grammar.start_state
         self.dead = False
 
     def clone(self) -> "GuidedState":
@@ -715,20 +729,20 @@ class GuidedState:
                 if e < g.vocab_size:
                     words[e >> 5] |= np.uint32(1) << np.uint32(e & 31)
             return words
-        return self.grammar.mask_words(self.sid)
+        return self.grammar.mask_words(self.state)
 
     def advance(self, token_id: int) -> None:
         if self.dead:
             return
-        nxt = self.grammar.advance(self.sid, token_id)
-        if nxt < 0:
+        nxt = self.grammar.advance(self.state, token_id)
+        if nxt is None:
             self.dead = True
         else:
-            self.sid = nxt
+            self.state = nxt
 
     @property
     def complete(self) -> bool:
-        return (not self.dead) and self.grammar.accepting(self.sid)
+        return (not self.dead) and self.grammar.accepting(self.state)
 
 
 # ---------------------------------------------------------------------------

@@ -146,6 +146,45 @@ def write_prompts_paged(pool_l: dict, tables: jnp.ndarray, k: jnp.ndarray,
         k, v)
 
 
+def write_prompts_paged_layer(pool: dict, layer, tables: jnp.ndarray,
+                              k: jnp.ndarray, v: jnp.ndarray,
+                              page_size: int) -> dict:
+    """FULL-pool ([L, P, ...] leaves) variant of :func:`write_prompts_paged`
+    for the scan-CARRY prefill path (round 5): the pool stays in the layer
+    scan's carry — XLA's loop-carry aliasing keeps it in place — instead of
+    streaming xs→ys, whose re-stack held a second full-size pool buffer in
+    the compiled program (the batch-128 paged HBM OOM recorded in
+    BENCH_session_r5.stderr.txt; the dense cache's simpler scatter pattern
+    aliased and survived). Same index/drop contract as the per-layer form,
+    with the scalar ``layer`` leading the scatter."""
+    N, T = k.shape[:2]
+    tok = jnp.arange(T, dtype=jnp.int32)
+    pg = tables[:, tok // page_size]                   # [N, T]
+    off = jnp.broadcast_to(tok % page_size, (N, T))
+    return _write_kv(
+        pool,
+        lambda arr, val: arr.at[layer, pg, :, off].set(val, mode="drop"),
+        k, v)
+
+
+def write_chunk_paged_layer(pool: dict, layer, pages: jnp.ndarray,
+                            start, k: jnp.ndarray, v: jnp.ndarray,
+                            page_size: int) -> dict:
+    """FULL-pool variant of :func:`write_chunk_paged` (carry prefill path —
+    see write_prompts_paged_layer). k/v: [1, C, Hkv, D]."""
+    C = k.shape[1]
+    rows = start + jnp.arange(C, dtype=jnp.int32)      # [C]
+    idx = rows // page_size
+    valid = idx < pages.shape[0]
+    pg = jnp.where(valid, pages[jnp.clip(idx, 0, pages.shape[0] - 1)],
+                   OOB_PAGE)
+    off = rows % page_size
+    return _write_kv(
+        pool,
+        lambda arr, val: arr.at[layer, pg, :, off].set(val, mode="drop"),
+        k[0], v[0])
+
+
 def write_chunk_paged(pool_l: dict, pages: jnp.ndarray, start: jnp.ndarray,
                       k: jnp.ndarray, v: jnp.ndarray, page_size: int) -> dict:
     """Write one prefill CHUNK's rows [start, start+C) across pages.

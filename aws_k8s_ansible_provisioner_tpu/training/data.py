@@ -63,14 +63,15 @@ def tokenize_files(paths: Union[str, Sequence[str]], tokenizer,
 class PackedCorpus:
     """Deterministic ``data_fn`` over a packed token stream.
 
-    Batch ``step`` covers stream positions
-    ``[step * batch * seq_len, ...)`` row-major, wrapping at the end — a
-    pure function of (stream, step), which is exactly what makes
-    checkpoint-resume bit-reproducible (the train loop replays from the
-    restored step with no data-iterator state). Targets are the shifted
-    stream (next-token prediction needs seq_len + 1 positions per row, so
-    consecutive rows overlap by one token). The loss mask is all-ones:
-    padding never exists — short corpora wrap instead.
+    Global row r covers stream positions ``[r * (seq_len - 1), ... + seq_len)``
+    — consecutive rows OVERLAP by one token because the train step forms
+    its targets by shifting within the row (trainer.py: ``logits[:, :-1]``
+    vs ``tokens[:, 1:]``), so a row of S tokens trains S - 1 predictions;
+    the overlap is what makes every adjacent stream pair a target exactly
+    once (review r5: a stride of S silently dropped 1/S of all targets at
+    row boundaries). Everything is a pure function of (stream, step), which
+    is what makes checkpoint-resume bit-reproducible (no iterator state).
+    The loss mask is all-ones: padding never exists — short corpora wrap.
 
     ``dp_rank``/``dp_size`` slice the BATCH axis for multi-host data
     parallelism: each host materializes only its rows of the global batch
@@ -88,24 +89,17 @@ class PackedCorpus:
         self.stream = np.asarray(stream, np.int32)
         self.batch, self.seq_len = batch, seq_len
         self.dp_rank, self.dp_size = dp_rank, dp_size
-        # tokens consumed per global batch (targets shift by one, rows
-        # overlap by that one token — see class docstring)
-        self._stride = batch * seq_len
 
     def row(self, global_row: int) -> np.ndarray:
-        """seq_len + 1 tokens starting at the row's stream offset, wrapped."""
-        start = (global_row * self.seq_len) % self.stream.size
-        idx = (start + np.arange(self.seq_len + 1)) % self.stream.size
+        """seq_len tokens at the row's (overlapping) stream offset, wrapped."""
+        start = (global_row * (self.seq_len - 1)) % self.stream.size
+        idx = (start + np.arange(self.seq_len)) % self.stream.size
         return self.stream[idx]
 
     def __call__(self, step: int) -> Tuple[np.ndarray, np.ndarray]:
-        rows = [self.row(step * self.batch + r)
-                for r in range(self.dp_rank, self.batch, self.dp_size)]
-        full = np.stack(rows)                     # [batch/dp, seq_len + 1]
-        # the train step computes its own shift from [B, S] inputs: feed
-        # the leading seq_len tokens; the +1 overlap guarantees the row's
-        # final target exists in the NEXT step's leading token
-        tokens = full[:, :self.seq_len]
+        tokens = np.stack([self.row(step * self.batch + r)
+                           for r in range(self.dp_rank, self.batch,
+                                          self.dp_size)])
         return tokens, np.ones_like(tokens)
 
     @property

@@ -432,6 +432,10 @@ def measure() -> None:
         # parent's retry attempt A/Bs TPU_BENCH_PAGED=0 so a paged-specific
         # Mosaic lowering failure can't zero the round's one measurement.
         paged=bool(int(env("TPU_BENCH_PAGED", "1"))),
+        # Paged DMA granularity: the paged decode kernel streams one page
+        # per grid step, so page_size is its chunk size — larger pages
+        # amortize grid-step overhead at the cost of coarser admission.
+        page_size=int(env("TPU_BENCH_PAGE_SIZE", "64")),
     )
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.bfloat16)
     engine = Engine(cfg, params, serving)

@@ -295,3 +295,42 @@ def test_paged_spec_kernel_parity(quant):
                                              **pkw)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_layer_writers_match_per_layer_forms(quant):
+    """The carry-path FULL-pool writers (round 5: the prefill layer scan
+    keeps the pool in its carry; see write_prompts_paged_layer) must write
+    exactly what the per-layer reference forms write at every layer."""
+    dense, pool, table = _identity_layout(quant=quant, perm_seed=7)
+    L = pool["k"].shape[0]
+    N, T = 2, 11
+    k = jax.random.normal(jax.random.PRNGKey(7), (N, T, 2, 16))
+    v = jax.random.normal(jax.random.PRNGKey(8), (N, T, 2, 16))
+    tables = table[jnp.array([2, 0])]
+    for layer in range(L):
+        ref_l = pkv.write_prompts_paged(
+            {n: a[layer] for n, a in pool.items()}, tables, k, v, PS)
+        got = pkv.write_prompts_paged_layer(pool, jnp.int32(layer), tables,
+                                            k, v, PS)
+        for name in ref_l:
+            np.testing.assert_array_equal(np.asarray(got[name][layer]),
+                                          np.asarray(ref_l[name]),
+                                          err_msg=f"{name} layer {layer}")
+            # other layers untouched
+            for other in range(L):
+                if other != layer:
+                    np.testing.assert_array_equal(
+                        np.asarray(got[name][other]),
+                        np.asarray(pool[name][other]))
+
+    C, start, slot = 12, 10, 2
+    kc = jax.random.normal(jax.random.PRNGKey(9), (1, C, 2, 16))
+    vc = jax.random.normal(jax.random.PRNGKey(10), (1, C, 2, 16))
+    ref_l = pkv.write_chunk_paged({n: a[1] for n, a in pool.items()},
+                                  table[slot], jnp.int32(start), kc, vc, PS)
+    got = pkv.write_chunk_paged_layer(pool, jnp.int32(1), table[slot],
+                                      jnp.int32(start), kc, vc, PS)
+    for name in ref_l:
+        np.testing.assert_array_equal(np.asarray(got[name][1]),
+                                      np.asarray(ref_l[name]), err_msg=name)

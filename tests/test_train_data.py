@@ -27,22 +27,26 @@ def test_tokenize_files_text_and_jsonl(tmp_path):
                                ord("c"), ord("d"), eos, ord("e"), eos]
 
 
-def test_packed_batches_cover_stream_in_order():
+def test_packed_batches_cover_stream_with_boundary_overlap():
+    """Rows stride seq_len - 1: each row's last token is the next row's
+    first, so every adjacent stream pair is trained exactly once (review
+    r5: a stride of seq_len dropped 1/seq_len of all targets)."""
     stream = np.arange(100, dtype=np.int32)
     corpus = PackedCorpus(stream, batch=2, seq_len=10)
     t0, m0 = corpus(0)
     assert t0.shape == (2, 10) and m0.all()
     assert t0[0].tolist() == list(range(0, 10))
-    assert t0[1].tolist() == list(range(10, 20))
+    assert t0[1].tolist() == list(range(9, 19))
     t1, _ = corpus(1)
-    assert t1[0].tolist() == list(range(20, 30))
+    assert t1[0].tolist() == list(range(18, 28))
+    assert t0[1][0] == t0[0][-1]        # the boundary pair is covered
 
 
 def test_wraparound_short_corpus():
     stream = np.arange(7, dtype=np.int32)
     corpus = PackedCorpus(stream, batch=1, seq_len=5)
-    t1, _ = corpus(1)               # starts at position 5, wraps at 7
-    assert t1[0].tolist() == [5, 6, 0, 1, 2]
+    t1, _ = corpus(1)               # starts at position 4, wraps at 7
+    assert t1[0].tolist() == [4, 5, 6, 0, 1]
 
 
 def test_determinism_is_resume_safe():
