@@ -1253,6 +1253,13 @@ class Engine:
                 raise ValueError(
                     f"guided grammar vocab ({req.guided.grammar.vocab_size}) "
                     f"exceeds model vocab ({self.cfg.vocab_size})")
+            if req.min_tokens > 0 and req.guided.grammar.exact:
+                # an exact-match grammar's final accepting state allows ONLY
+                # eos; the min_tokens device ban would mask that too,
+                # leaving an all--inf logits row (review r5)
+                raise ValueError(
+                    "min_tokens cannot combine with exact-match guided "
+                    "decoding (guided_regex / guided_choice)")
         budget = self.max_len - len(req.prompt_ids) - 1
         if req.max_tokens > budget:
             req.max_tokens = max(1, budget)
@@ -2047,14 +2054,6 @@ class Engine:
         if gset and not any(self.slot_req[s] is not None and s not in gset
                             for s in active):
             horizon = 1
-        elif gset and self.counts is not None and any(
-                self.pres_pens[s] or self.freq_pens[s]
-                or self.rep_pens[s] != 1.0 for s in gset):
-            # a penalized guided slot cannot ride the mixed fused horizon:
-            # the device increments its penalty-count row for EVERY substep,
-            # but the host discards its surplus tokens — phantom counts
-            # would silently skew its penalties (review r5)
-            horizon = 1
         gslots = list(gset)
         want_lp = self._want_logprobs(self.slot_req)
         want_pen = self.counts is not None and bool(
@@ -2112,6 +2111,24 @@ class Engine:
                 self.sched.note_decode(slot, 1)
                 self._emit(slot, int(out[s, slot]), lp)
                 emitted += 1
+        if want_pen and gslots and horizon > 1:
+            # the fused dispatch incremented guided slots' device-side
+            # penalty-count rows for EVERY substep, but only substep 0 was
+            # emitted — resync those rows from the authoritative host
+            # stream (review r5: the first fix dropped the whole batch to
+            # horizon 1 for one penalized guided request; this one costs a
+            # single [V]-row scatter per guided slot instead)
+            for slot in gslots:
+                req = self.slot_req[slot]
+                if req is None or not (self.pres_pens[slot]
+                                       or self.freq_pens[slot]
+                                       or self.rep_pens[slot] != 1.0):
+                    continue
+                row = np.bincount(np.asarray(req.generated, np.int64),
+                                  minlength=self.cfg.vocab_size)
+                self.counts = _restore_count_row(
+                    self.counts, jnp.int32(slot),
+                    jnp.asarray(row, jnp.int32))
         self._tok_times.append((t0, emitted))
         if len(self._tok_times) >= 2:
             span = time.monotonic() - self._tok_times[0][0]

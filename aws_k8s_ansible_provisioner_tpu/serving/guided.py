@@ -1,4 +1,6 @@
-"""Guided decoding: OpenAI ``response_format`` (json_object / json_schema).
+"""Guided decoding: OpenAI ``response_format`` (json_object / json_schema)
+plus vLLM's ``guided_json`` / ``guided_regex`` / ``guided_choice`` extensions
+(:func:`grammar_for_request`; regex subset compiled by :func:`parse_regex`).
 
 The reference serves this through its delegated vLLM engine (SURVEY.md §2.2
 row 1: the OpenAI surface exercised by ``/root/reference/llm-d-test.yaml``
@@ -461,13 +463,19 @@ def schema_to_rx(schema) -> tuple:
 
 
 class NfaMachine:
-    """Char machine over a compiled NFA; states are frozensets of nodes."""
+    """Char machine over a compiled NFA; states are frozensets of nodes.
 
-    def __init__(self, rx):
+    ``pad_ws`` (the json_schema default) wraps the language in optional
+    whitespace; exact-match modes (guided_regex / guided_choice) keep the
+    language as written."""
+
+    def __init__(self, rx, pad_ws: bool = True):
         nfa = _Nfa()
         self._start_node = nfa.node()
         self._accept = nfa.node()
-        _build(nfa, _seq(_RX_WS, rx, _RX_WS), self._start_node, self._accept)
+        if pad_ws:
+            rx = _seq(_RX_WS, rx, _RX_WS)
+        _build(nfa, rx, self._start_node, self._accept)
         self._nfa = nfa
 
     def _closure(self, nodes) -> frozenset:
@@ -493,6 +501,232 @@ class NfaMachine:
 
     def accepting(self, st) -> bool:
         return self._accept in st
+
+
+# ---------------------------------------------------------------------------
+# Regex → AST (vLLM ``guided_regex``)
+# ---------------------------------------------------------------------------
+
+_CLASS_SHORTCUTS = {
+    "d": frozenset(b"0123456789"),
+    "w": frozenset(b"abcdefghijklmnopqrstuvwxyz"
+                   b"ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_"),
+    "s": frozenset(b" \t\n\r\f\v"),
+}
+_ANY = frozenset(b for b in range(256) if b != 0x0A)   # '.' excludes \n
+_REP_CAP = 256      # per-quantifier {m,n} bound
+# TOTAL expanded-AST atom budget: counted quantifiers compose
+# MULTIPLICATIVELY under nesting ("((a{256}){256})" is 65k atoms from 12
+# chars), and grammars compile synchronously in the request handler — the
+# per-quantifier cap alone left a one-request DoS (review r5)
+_RX_NODE_CAP = 10_000
+
+
+def _rx_size(rx) -> int:
+    kind = rx[0]
+    if kind in ("lit", "cls"):
+        return max(1, len(rx[1])) if kind == "lit" else 1
+    if kind in ("seq", "alt"):
+        return 1 + sum(_rx_size(p) for p in rx[1])
+    return 1 + _rx_size(rx[1])                     # star / opt
+
+
+def parse_regex(pattern: str) -> tuple:
+    """Parse a practical regex subset into the NFA-combinator AST.
+
+    Supported: literals, escapes (incl. \\d \\w \\s and their negations),
+    ``.``, ``[...]`` classes with ranges/negation, ``|``, ``(...)`` and
+    ``(?:...)`` groups, ``* + ? {m} {m,} {m,n}`` (also non-greedy suffix
+    ``?``, which constrains the same language). Anchors ``^``/``$`` at the
+    ends are accepted and ignored (the whole output matches by
+    construction). Unsupported constructs (backrefs, lookaround) raise
+    ``ValueError`` → HTTP 400. ASCII/byte semantics: multi-byte UTF-8
+    literals work byte-wise; classes are byte classes.
+    """
+    data = pattern.encode()
+    pos = 0
+
+    def err(msg):
+        raise ValueError(f"guided_regex: {msg} at offset {pos} in "
+                         f"{pattern!r}")
+
+    def peek():
+        return data[pos] if pos < len(data) else None
+
+    def parse_alt():
+        nonlocal pos
+        parts = [parse_seq()]
+        while peek() == 0x7C:                      # '|'
+            pos += 1
+            parts.append(parse_seq())
+        return parts[0] if len(parts) == 1 else _alt(*parts)
+
+    def parse_seq():
+        nonlocal pos
+        out = []
+        while True:
+            c = peek()
+            if c is None or c in (0x7C, 0x29):     # '|' ')'
+                break
+            out.append(parse_repeat())
+        return _seq(*out) if len(out) != 1 else out[0]
+
+    def parse_repeat():
+        nonlocal pos
+        atom = parse_atom()
+        while True:
+            c = peek()
+            if c == 0x2A:                          # '*'
+                atom, pos = _star(atom), pos + 1
+            elif c == 0x2B:                        # '+'
+                atom, pos = _plus(atom), pos + 1
+            elif c == 0x3F:                        # '?'
+                atom, pos = _opt(atom), pos + 1
+            elif c == 0x7B:                        # '{'
+                end = data.find(b"}", pos)
+                if end < 0:
+                    err("unterminated {quantifier}")
+                spec = data[pos + 1:end].decode()
+                pos = end + 1
+                m, _, n = spec.partition(",")
+                try:
+                    lo = int(m)
+                    hi = None if _ and not n else (lo if not _ else int(n))
+                except ValueError:
+                    err(f"bad quantifier {{{spec}}}")
+                if lo > _REP_CAP or (hi is not None and hi > _REP_CAP):
+                    err(f"quantifier beyond the {_REP_CAP} bound")
+                if hi is not None and hi < lo:
+                    err(f"reversed quantifier {{{spec}}}")
+                reps = lo + (1 if hi is None else hi - lo)
+                if _rx_size(atom) * max(1, reps) > _RX_NODE_CAP:
+                    err(f"pattern expansion beyond the {_RX_NODE_CAP}-node "
+                        f"budget")
+                rep = [atom] * lo
+                if hi is None:
+                    rep.append(_star(atom))
+                else:
+                    rep += [_opt(atom)] * (hi - lo)
+                atom = _seq(*rep)
+            else:
+                break
+            if peek() == 0x3F:                     # non-greedy: same language
+                pos += 1
+        return atom
+
+    def parse_class_escape():
+        """One escape inside or outside a class → (set|byte)."""
+        nonlocal pos
+        pos += 1
+        c = peek()
+        if c is None:
+            err("dangling backslash")
+        pos += 1
+        ch = chr(c)
+        if ch in _CLASS_SHORTCUTS:
+            return _CLASS_SHORTCUTS[ch]
+        if ch.upper() in _CLASS_SHORTCUTS and ch.isupper():
+            return frozenset(range(256)) - _CLASS_SHORTCUTS[ch.lower()]
+        mapped = {"n": 0x0A, "t": 0x09, "r": 0x0D, "f": 0x0C, "v": 0x0B,
+                  "0": 0x00}.get(ch)
+        if mapped is not None:
+            return mapped
+        if ch == "x":
+            hx = data[pos:pos + 2].decode()
+            pos += 2
+            try:
+                return int(hx, 16)
+            except ValueError:
+                err(f"bad \\x escape {hx!r}")
+        if ch.isalnum():
+            err(f"unsupported escape \\{ch}")
+        return c                                   # escaped punctuation
+
+    def parse_atom():
+        nonlocal pos
+        c = peek()
+        if c == 0x28:                              # '('
+            pos += 1
+            if data[pos:pos + 2] == b"?:":
+                pos += 2
+            elif peek() == 0x3F:
+                err("unsupported (?...) construct")
+            inner = parse_alt()
+            if peek() != 0x29:
+                err("unterminated group")
+            pos += 1
+            return inner
+        if c == 0x5B:                              # '['
+            return _cls(parse_class())
+        if c == 0x2E:                              # '.'
+            pos += 1
+            return _cls(_ANY)
+        if c == 0x5E:                              # '^' only valid leading
+            if pos != 0:
+                err("mid-pattern '^' anchors are unsupported")
+            pos += 1
+            return _seq()
+        if c == 0x24:                              # '$' only valid trailing
+            if pos != len(data) - 1:
+                err("mid-pattern '$' anchors are unsupported")
+            pos += 1
+            return _seq()
+        if c == 0x5C:
+            got = parse_class_escape()
+            return _cls(got) if isinstance(got, frozenset) else \
+                _lit(bytes([got]))
+        if c in (0x2A, 0x2B, 0x3F, 0x7B):
+            err("quantifier with nothing to repeat")
+        pos += 1
+        return _lit(bytes([c]))
+
+    def parse_class():
+        nonlocal pos
+        pos += 1                                   # consume '['
+        negate = peek() == 0x5E
+        if negate:
+            pos += 1
+        out = set()
+        first = True
+        while True:
+            c = peek()
+            if c is None:
+                err("unterminated character class")
+            if c == 0x5D and not first:            # ']'
+                pos += 1
+                break
+            first = False
+            if c == 0x5C:
+                got = parse_class_escape()
+                if isinstance(got, frozenset):
+                    out |= got
+                    continue
+                lo = got
+            else:
+                lo = c
+                pos += 1
+            if peek() == 0x2D and pos + 1 < len(data) \
+                    and data[pos + 1] != 0x5D:     # range a-b
+                pos += 1
+                hi = peek()
+                if hi == 0x5C:
+                    hi = parse_class_escape()
+                    if isinstance(hi, frozenset):
+                        err("class shortcut cannot end a range")
+                else:
+                    pos += 1
+                if hi < lo:
+                    err("reversed class range")
+                out |= set(range(lo, hi + 1))
+            else:
+                out.add(lo)
+        return frozenset(range(256)) - frozenset(out) if negate \
+            else frozenset(out)
+
+    rx = parse_alt()
+    if pos != len(data):
+        err("unbalanced ')'")
+    return rx
 
 
 # ---------------------------------------------------------------------------
@@ -577,8 +811,13 @@ class TokenGrammar:
     once per distinct grammar state ever visited.
     """
 
-    def __init__(self, machine, tokenizer, eos_ids):
+    def __init__(self, machine, tokenizer, eos_ids, exact: bool = False):
         self._m = machine
+        # exact-match grammars (guided_regex / guided_choice) allow NOTHING
+        # in their final accepting states — not even whitespace — so a
+        # device-side min_tokens eos-ban would leave an all-masked logits
+        # row; engine.submit rejects that combination (review r5)
+        self.exact = exact
         self._eos = [e for e in (eos_ids or []) if e is not None]
         tb = token_byte_table(tokenizer)
         self.vocab_size = len(tb)
@@ -790,3 +1029,65 @@ def _cache_put(key, g):
     if len(_GRAMMAR_CACHE) >= _CACHE_CAP:
         _GRAMMAR_CACHE.pop(next(iter(_GRAMMAR_CACHE)))
     _GRAMMAR_CACHE[key] = g
+
+
+def _cached(tokenizer, key_tail: str, build) -> TokenGrammar:
+    key = (id(tokenizer), key_tail)
+    g = _GRAMMAR_CACHE.get(key)
+    if g is None:
+        g = build()
+        _cache_put(key, g)
+    return g
+
+
+def grammar_for_request(tokenizer, body: dict, eos_ids):
+    """Resolve a request body's constrained-output spec to a TokenGrammar.
+
+    Beside OpenAI ``response_format``, accepts vLLM's sampling-params
+    extensions: ``guided_json`` (a JSON schema), ``guided_regex`` (compiled
+    by :func:`parse_regex`), and ``guided_choice`` (list of exact strings).
+    At most one spec may be present. Returns None when unconstrained;
+    raises ValueError (→ HTTP 400) on conflicts or malformed specs.
+    """
+    specs = [k for k in ("response_format", "guided_json", "guided_regex",
+                         "guided_choice") if body.get(k) is not None]
+    if not specs:
+        return None
+    # a present-but-null response_format is "unset" (OpenAI SDKs serialize
+    # it that way) — body.get's default doesn't cover that, hence `or {}`
+    rf = body.get("response_format") or {}
+    if rf.get("type") in (None, "text") and specs == ["response_format"]:
+        return None
+    if len(specs) > 1:
+        raise ValueError(f"at most one guided-decoding spec allowed, got "
+                         f"{specs}")
+    kind = specs[0]
+    if kind == "response_format":
+        return grammar_for(tokenizer, body["response_format"], eos_ids)
+    if kind == "guided_json":
+        schema = body["guided_json"]
+        if not isinstance(schema, dict):
+            raise ValueError("guided_json must be a JSON schema object")
+        return _cached(
+            tokenizer, "json:" + json.dumps(schema, sort_keys=True),
+            lambda: TokenGrammar(NfaMachine(schema_to_rx(schema)),
+                                 tokenizer, eos_ids))
+    if kind == "guided_regex":
+        pattern = body["guided_regex"]
+        if not isinstance(pattern, str) or not pattern:
+            raise ValueError("guided_regex must be a non-empty string")
+        return _cached(
+            tokenizer, "re:" + pattern,
+            lambda: TokenGrammar(
+                NfaMachine(parse_regex(pattern), pad_ws=False),
+                tokenizer, eos_ids, exact=True))
+    choices = body["guided_choice"]
+    if not isinstance(choices, list) or not choices \
+            or not all(isinstance(c, str) for c in choices):
+        raise ValueError("guided_choice must be a non-empty list of strings")
+    return _cached(
+        tokenizer, "choice:" + json.dumps(choices),
+        lambda: TokenGrammar(
+            NfaMachine(_alt(*[_lit(c.encode()) for c in choices]),
+                       pad_ws=False),
+            tokenizer, eos_ids, exact=True))

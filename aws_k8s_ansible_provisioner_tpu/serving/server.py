@@ -434,23 +434,21 @@ class Handler(BaseHTTPRequestHandler):
         if so and not stream:
             return self._error(400, "'stream_options' requires stream=true")
         include_usage = bool(so.get("include_usage", False))
-        # OpenAI ``response_format``: json_object / json_schema constrained
-        # output via the grammar-mask sampler (serving/guided.py). The
-        # compiled grammar is cached per (tokenizer, schema); each sibling
-        # request gets its own FSM cursor (engine.submit wraps the grammar).
-        guided = None
+        # Constrained output via the grammar-mask sampler (serving/guided.py):
+        # OpenAI ``response_format`` (json_object/json_schema) plus vLLM's
+        # guided_json / guided_regex / guided_choice extensions. Compiled
+        # grammars are cached per (tokenizer, spec); each sibling request
+        # gets its own FSM cursor (engine.submit wraps the grammar).
         rf = body.get("response_format")
-        if rf is not None:
-            if not isinstance(rf, dict):
-                return self._error(400, "'response_format' must be an object")
-            if rf.get("type") not in (None, "text"):
-                from aws_k8s_ansible_provisioner_tpu.serving.guided import (
-                    grammar_for)
-                try:
-                    guided = grammar_for(st.tokenizer, rf,
+        if rf is not None and not isinstance(rf, dict):
+            return self._error(400, "'response_format' must be an object")
+        from aws_k8s_ansible_provisioner_tpu.serving.guided import (
+            grammar_for_request)
+        try:
+            guided = grammar_for_request(st.tokenizer, body,
                                          sorted(st.engine._eos_set))
-                except ValueError as e:
-                    return self._error(400, f"response_format: {e}")
+        except ValueError as e:
+            return self._error(400, f"guided decoding: {e}")
 
         prompt_ids = st.tokenizer.encode(prompt_text)
         if not prompt_ids:

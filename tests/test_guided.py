@@ -562,3 +562,164 @@ def test_token_byte_table_sentencepiece_byte_fallback():
     assert tb[2] == b'"'
     assert tb[3] == b"\n"
     assert tb[4] == b"x"
+
+
+# ---------------------------------------------------------------------------
+# vLLM guided_regex / guided_choice / guided_json extensions
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pattern,ok,bad", [
+    (r"[a-c]+\d{2}", ["ab12", "c00", "abc99"], ["ab1", "d12", "ab123"]),
+    (r"(foo|ba[rz])?-x", ["-x", "foo-x", "bar-x", "baz-x"], ["bax-x", "f-x"]),
+    (r"\w+@\w+\.(com|org)", ["a_1@b.com", "x@y.org"], ["a@b.net", "@b.com"]),
+    (r"yes|no", ["yes", "no"], ["yesno", " yes", "maybe"]),
+    (r"a{2,3}", ["aa", "aaa"], ["a", "aaaa"]),
+    (r"^[^,]+$", ["abc", "x y"], ["a,b"]),
+    (r"\x41.\n?", ["AB", "Az\n"], ["BA", "A\nz"]),
+])
+def test_parse_regex_language(pattern, ok, bad):
+    from aws_k8s_ansible_provisioner_tpu.serving.guided import (NfaMachine,
+                                                                parse_regex)
+
+    m = NfaMachine(parse_regex(pattern), pad_ws=False)
+    for s in ok:
+        assert _accepts(m, s), (pattern, s)
+    for s in bad:
+        assert not _accepts(m, s), (pattern, s)
+
+
+def test_parse_regex_rejects_unsupported():
+    from aws_k8s_ansible_provisioner_tpu.serving.guided import parse_regex
+
+    for bad in (r"(?=x)y", r"a{9999}", r"[z-a]", r"(unclosed", r"a\q"):
+        with pytest.raises(ValueError):
+            parse_regex(bad)
+
+
+def test_grammar_for_request_modes_and_conflicts():
+    from aws_k8s_ansible_provisioner_tpu.serving.guided import (
+        grammar_for_request)
+
+    tok = ByteTokenizer()
+    eos = [tok.eos_token_id]
+    assert grammar_for_request(tok, {}, eos) is None
+    assert grammar_for_request(tok, {"response_format": {"type": "text"}},
+                               eos) is None
+    g = grammar_for_request(tok, {"guided_choice": ["cat", "dog"]}, eos)
+    assert g is grammar_for_request(tok, {"guided_choice": ["cat", "dog"]},
+                                    eos)
+    with pytest.raises(ValueError, match="at most one"):
+        grammar_for_request(tok, {"guided_regex": "a+",
+                                  "guided_choice": ["x"]}, eos)
+    with pytest.raises(ValueError):
+        grammar_for_request(tok, {"guided_choice": []}, eos)
+    with pytest.raises(ValueError):
+        grammar_for_request(tok, {"guided_json": "not-a-dict"}, eos)
+
+
+def test_http_guided_choice_and_regex(server):
+    code, resp = _post(server + "/v1/completions", {
+        "model": MODEL_NAME, "prompt": "pick:",
+        "guided_choice": ["alpha", "beta"],
+        "max_tokens": 16, "temperature": 0.0,
+    })
+    assert code == 200
+    assert resp["choices"][0]["text"] in ("alpha", "beta")
+
+    code, resp = _post(server + "/v1/completions", {
+        "model": MODEL_NAME, "prompt": "code:",
+        "guided_regex": r"[A-Z]{3}-\d{2}",
+        "max_tokens": 16, "temperature": 0.0,
+    })
+    assert code == 200
+    import re as _re
+    assert _re.fullmatch(r"[A-Z]{3}-\d{2}", resp["choices"][0]["text"]), \
+        resp["choices"][0]["text"]
+
+    code, resp = _post(server + "/v1/completions", {
+        "model": MODEL_NAME, "prompt": "j:",
+        "guided_json": {"type": "object",
+                        "properties": {"ok": {"type": "boolean"}},
+                        "required": ["ok"]},
+        "max_tokens": 32, "temperature": 0.0, "logit_bias": _BIAS,
+    })
+    assert code == 200
+    assert isinstance(json.loads(resp["choices"][0]["text"])["ok"], bool)
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server + "/v1/completions", {
+            "model": MODEL_NAME, "prompt": "x",
+            "guided_regex": "(?=bad)"})
+    assert e.value.code == 400
+
+
+def test_regex_nested_quantifier_budget():
+    """Counted quantifiers compose multiplicatively; the total-expansion
+    budget must reject the bomb BEFORE NFA construction (review r5)."""
+    from aws_k8s_ansible_provisioner_tpu.serving.guided import parse_regex
+
+    import time
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="budget"):
+        parse_regex("((((a{256}){256}){256}){256})")
+    assert time.monotonic() - t0 < 2.0, "rejection must be cheap"
+    with pytest.raises(ValueError, match="reversed"):
+        parse_regex("a{5,2}")
+    with pytest.raises(ValueError, match="anchors"):
+        parse_regex("foo$bar")
+    with pytest.raises(ValueError, match="anchors"):
+        parse_regex("a^b")
+    # legitimate large-but-bounded patterns still compile
+    parse_regex("^[A-Z]{8}-[0-9]{8}$")
+
+
+def test_min_tokens_rejected_for_exact_grammars(engine):
+    eng, tok = engine
+    from aws_k8s_ansible_provisioner_tpu.serving.guided import (
+        grammar_for_request)
+
+    g = grammar_for_request(tok, {"guided_choice": ["cat", "dog"]},
+                            [tok.eos_token_id])
+    with pytest.raises(ValueError, match="min_tokens"):
+        eng.generate(tok.encode("x"), guided=g, min_tokens=5)
+    # json grammars keep whitespace open at accept — combination allowed
+    gj = grammar_for(tok, {"type": "json_object"}, [tok.eos_token_id])
+    req = eng.generate(tok.encode("x"), guided=gj, min_tokens=2,
+                       max_tokens=40, temperature=0.0, logit_bias=_PRESSURE)
+    _drain(eng)
+    assert len(req.generated) >= 2
+
+
+def test_null_response_format_beside_guided_key():
+    """OpenAI SDKs serialize unset response_format as null — must be
+    treated as absent, not crash (review r5)."""
+    from aws_k8s_ansible_provisioner_tpu.serving.guided import (
+        grammar_for_request)
+
+    tok = ByteTokenizer()
+    g = grammar_for_request(tok, {"response_format": None,
+                                  "guided_choice": ["a"]},
+                            [tok.eos_token_id])
+    assert g is not None
+    assert grammar_for_request(tok, {"response_format": None},
+                               [tok.eos_token_id]) is None
+
+
+def test_penalized_guided_keeps_counts_exact(engine):
+    """A guided slot with frequency_penalty in a MIXED batch rides the
+    fused horizon; its device count row must be resynced to the emitted
+    stream, so its output equals the same request run alone (review r5:
+    phantom counts from discarded surplus substeps)."""
+    eng, tok = engine
+    g = grammar_for(tok, {"type": "json_object"}, [tok.eos_token_id])
+    kw = dict(guided=g, max_tokens=60, temperature=0.0,
+              frequency_penalty=0.8, logit_bias=_PRESSURE)
+    solo = _run(eng, tok, "alone:", **kw)
+    mixed = eng.generate(tok.encode("alone:"), **kw)
+    neighbor = eng.generate(tok.encode("n"), max_tokens=30, temperature=0.0,
+                            ignore_eos=True)
+    _drain(eng)
+    assert len(neighbor.generated) == 30
+    assert mixed.generated == solo.generated, \
+        "mixed-batch penalized guided stream diverged from solo run"
