@@ -313,3 +313,41 @@ def test_dp_paged_admission_and_preemption_are_group_local(setup):
         if not single.step():
             break
     assert [r.generated for r in reqs] == [r.generated for r in ref]
+
+
+def test_mesh_guided_decoding_valid_json(setup):
+    """Guided decoding under a dp x tp mesh: the [B, V/32] allow-bitmask is
+    an unsharded dispatch input GSPMD must partition against the sharded
+    logits — a random-weight meshed engine must still emit valid JSON."""
+    import json as _json
+
+    from aws_k8s_ansible_provisioner_tpu.serving.guided import grammar_for
+    from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    from aws_k8s_ansible_provisioner_tpu.config import tiny_qwen3 as _tq
+    from aws_k8s_ansible_provisioner_tpu.models.layers import init_params as _ip
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    cfg = _tq(vocab_size=260, eos_token_id=tok.eos_token_id,
+              num_heads=4, num_kv_heads=2)
+    params = _ip(cfg, _jax.random.PRNGKey(0), dtype=_jnp.float32)
+    serving = ServingConfig(max_decode_slots=4, max_cache_len=128,
+                            prefill_buckets=(16, 32), dtype="float32",
+                            decode_horizon=4)
+    eng = Engine(cfg, params, serving, mesh=_mesh(2, 2))
+    g = grammar_for(tok, {"type": "json_object"}, [tok.eos_token_id])
+    pressure = ((32, -50.0), (9, -50.0), (10, -50.0), (13, -50.0),
+                (91, -20.0), (92, -100.0), (34, 30.0), (125, 20.0),
+                (93, 15.0), (58, 20.0), (44, 5.0), (258, 100.0))
+    req = eng.generate(tok.encode("j:"), guided=g, max_tokens=60,
+                       temperature=0.0, logit_bias=pressure)
+    plain = eng.generate(tok.encode("n"), max_tokens=12, temperature=0.0,
+                         ignore_eos=True)
+    for _ in range(10000):
+        if not eng.step():
+            break
+    assert req.finish_reason == "stop"
+    assert isinstance(_json.loads(tok.decode(req.generated)), dict)
+    assert len(plain.generated) == 12
