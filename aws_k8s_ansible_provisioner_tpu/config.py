@@ -710,6 +710,9 @@ class ServingConfig:
     # Draft model for spec_method="draft": a (small) HF checkpoint dir; the
     # server loads it unsharded beside the target (serving/draft.py).
     draft_checkpoint_dir: str = ""
+    # Multi-LoRA (models/lora.py): ("name=path", ...) peft adapter dirs,
+    # served as model ids beside the base (the vLLM --enable-lora contract).
+    lora_adapters: tuple = ()
     chat_template: str = ""  # path to a .jinja file; empty = model family default
     mesh: MeshConfig = field(default_factory=MeshConfig)
 

@@ -290,6 +290,32 @@ def param_count(params) -> int:
 # ---------------------------------------------------------------------------
 
 
+import contextlib as _contextlib
+import threading as _threading
+
+# Multi-LoRA dispatch context (models/lora.py): the per-slot adapter-index
+# vector is set at TRACE time by the serving step functions (engine.py) and
+# read here — threading a new argument through every block/model signature
+# for one serving feature would touch every call site; the context confines
+# it to the two ends. The value is a tracer belonging to the SAME trace
+# that calls _linear, which is the one pattern where trace-time ambient
+# state is sound.
+_LORA = _threading.local()
+
+
+@_contextlib.contextmanager
+def lora_context(idx):
+    """Apply per-row LoRA adapter indices ([B] int32, 0 = base) to every
+    _linear whose params carry lora_A/lora_B leaves, for the duration of
+    the trace inside."""
+    prev = getattr(_LORA, "idx", None)
+    _LORA.idx = idx
+    try:
+        yield
+    finally:
+        _LORA.idx = prev
+
+
 def _linear(x, p):
     if "scale" in p:
         # Weights-only int8 (models/quant.py): the upcast fuses into the
@@ -299,6 +325,17 @@ def _linear(x, p):
         y = ((x @ p["kernel"].astype(x.dtype)) * p["scale"]).astype(x.dtype)
     else:
         y = x @ p["kernel"]
+    if "lora_A" in p:
+        idx = getattr(_LORA, "idx", None)
+        if idx is not None:
+            # per-row low-rank delta: gather each row's adapter factors
+            # (index 0 is the all-zero base adapter) and fold x@A@B in —
+            # O(B·T·r·(din+dout)) beside the base matmul
+            A = p["lora_A"][idx].astype(x.dtype)       # [B, din, r]
+            Bm = p["lora_B"][idx].astype(x.dtype)      # [B, r, dout]
+            delta = jnp.einsum("b...r,bro->b...o",
+                               jnp.einsum("b...i,bir->b...r", x, A), Bm)
+            y = y + delta.astype(y.dtype)
     if "bias" in p:
         y = y + p["bias"]
     return y

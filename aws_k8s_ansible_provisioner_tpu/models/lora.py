@@ -1,0 +1,159 @@
+"""Multi-LoRA serving: per-request adapters batched into one dispatch.
+
+The reference's delegated vLLM engine serves LoRA adapters as first-class
+model ids (``--enable-lora``; SURVEY.md §2.2 row 1) — fine-tuned variants
+share one set of base weights and every continuous batch mixes adapters
+freely. TPU-first design here:
+
+- Adapters are STACKED along a leading adapter axis and attached to the
+  layer param tree (``lora_A`` [L, n+1, din, r], ``lora_B``
+  [L, n+1, r, dout] beside each targeted kernel), so they ride the layer
+  scan exactly like the base weights — one compiled program serves every
+  adapter mix, no per-adapter program variants, no recompiles when
+  adapters differ across slots.
+- Index 0 is the BASE (all-zero) adapter: un-adapted slots compute a zero
+  delta through the same einsum, which keeps the dispatch shape static —
+  the standard no-program-variant trick the ban/bias rows use.
+- The per-slot adapter index rides the dispatch as a [B] vector; the
+  forward applies ``y += (x @ A[idx]) @ B[idx]`` with per-slot gathered
+  factors (models/layers._linear) — batched-GEMM work of O(B·T·r·(din+
+  dout)), negligible beside the base matmul at r ≈ 8-64.
+- The peft ``lora_alpha / r`` scaling folds into B at load time, so the
+  runtime carries no per-adapter scalars.
+
+Scope (documented): HF/peft checkpoint format; targets q/k/v/o and the
+dense MLP projections. MoE expert matrices and embeddings are not
+targetable (loader raises). Mesh-sharded serving with LoRA is not wired
+yet (Engine raises) — the stacked-adapter axis would shard trivially, but
+the pspecs are not written.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+# peft module name -> our stacked-layer param name
+TARGET_MAP = {
+    "q_proj": "wq",
+    "k_proj": "wk",
+    "v_proj": "wv",
+    "o_proj": "wo",
+    "gate_proj": "w_gate",
+    "up_proj": "w_up",
+    "down_proj": "w_down",
+}
+
+
+def load_adapter(adapter_dir: str) -> dict:
+    """Read one peft adapter dir → {target: (A [L, din, r], B [L, r, dout])}.
+
+    peft stores per-layer ``...layers.<i>.<module>.<proj>.lora_A.weight``
+    [r, din] and ``lora_B.weight`` [dout, r]; this stacks them over layers
+    in OUR orientation (right-multiplication) and folds ``lora_alpha / r``
+    into B.
+    """
+    from safetensors import numpy as st_np
+
+    cfg_path = os.path.join(adapter_dir, "adapter_config.json")
+    with open(cfg_path) as fh:
+        acfg = json.load(fh)
+    r = int(acfg["r"])
+    for patterned in ("alpha_pattern", "rank_pattern"):
+        if acfg.get(patterned):
+            # silently applying a uniform scale to per-module overrides
+            # would serve degraded adapters with no diagnostic (review r5)
+            raise ValueError(f"adapter {adapter_dir}: {patterned} per-module "
+                             f"overrides are not supported")
+    alpha = float(acfg.get("lora_alpha", r))
+    # rslora (Kalajdzievski 2023): scaling is alpha / sqrt(r), not alpha / r
+    scale = alpha / (r ** 0.5) if acfg.get("use_rslora") else alpha / r
+    weights_path = os.path.join(adapter_dir, "adapter_model.safetensors")
+    raw = st_np.load_file(weights_path)
+
+    per_target: Dict[str, Dict[int, Tuple[np.ndarray, np.ndarray]]] = {}
+    for key, val in raw.items():
+        parts = key.split(".")
+        try:
+            li = parts.index("layers") + 1
+            layer = int(parts[li])
+        except ValueError:
+            raise ValueError(f"unsupported adapter key (no layer index): "
+                             f"{key}")
+        proj = next((p for p in parts if p in TARGET_MAP), None)
+        if proj is None:
+            raise ValueError(f"adapter targets an unsupported module: {key} "
+                             f"(supported: {sorted(TARGET_MAP)})")
+        which = "A" if "lora_A" in key else "B"
+        slot = per_target.setdefault(TARGET_MAP[proj], {}) \
+            .setdefault(layer, [None, None])
+        slot[0 if which == "A" else 1] = np.asarray(val, np.float32)
+
+    out = {}
+    for target, layers in per_target.items():
+        L = max(layers) + 1
+        a_l, b_l = [], []
+        for i in range(L):
+            pair = layers.get(i)
+            if pair is None or pair[0] is None or pair[1] is None:
+                raise ValueError(f"adapter {adapter_dir}: target {target} "
+                                 f"missing layer {i} A/B pair")
+            a, b = pair
+            a_l.append(a.T)                    # [din, r]
+            b_l.append(b.T * scale)            # [r, dout] (alpha/r folded)
+        out[target] = (np.stack(a_l), np.stack(b_l))
+    if not out:
+        raise ValueError(f"adapter {adapter_dir} has no LoRA tensors")
+    return {"r": r, "targets": out}
+
+
+def stack_adapters(adapters: List[dict], num_layers: int, dtype) -> dict:
+    """Stack N loaded adapters (+ the zero base adapter at index 0) into
+    the attachable tree: {target: {"lora_A": [L, N+1, din, r_max],
+    "lora_B": [L, N+1, r_max, dout]}}. Ranks pad with zeros (a zero-padded
+    rank contributes nothing — exactness preserved)."""
+    targets = sorted({t for ad in adapters for t in ad["targets"]})
+    r_max = max(ad["r"] for ad in adapters)
+    out = {}
+    for t in targets:
+        dims = next(ad["targets"][t] for ad in adapters if t in ad["targets"])
+        din, dout = dims[0].shape[1], dims[1].shape[2]
+        A = np.zeros((num_layers, len(adapters) + 1, din, r_max), np.float32)
+        B = np.zeros((num_layers, len(adapters) + 1, r_max, dout), np.float32)
+        for n, ad in enumerate(adapters):
+            if t not in ad["targets"]:
+                continue
+            a, b = ad["targets"][t]
+            if a.shape[0] != num_layers:
+                raise ValueError(
+                    f"adapter layer count {a.shape[0]} != model "
+                    f"{num_layers} for target {t}")
+            A[:, n + 1, :, :ad["r"]] = a
+            B[:, n + 1, :ad["r"], :] = b
+        out[t] = {"lora_A": jnp.asarray(A, dtype),
+                  "lora_B": jnp.asarray(B, dtype)}
+    return out
+
+
+def attach(params: dict, stacked: dict) -> dict:
+    """Return params with lora_A/lora_B leaves beside each targeted kernel
+    (non-destructive copy of the touched subtrees)."""
+    layers = dict(params["layers"])
+    for target, leaves in stacked.items():
+        if target not in layers:
+            raise ValueError(f"model has no target {target!r} "
+                             f"(MoE experts are not LoRA-targetable)")
+        sub = dict(layers[target])
+        if sub["kernel"].ndim != 3:
+            raise ValueError(f"target {target!r} is not a dense [L, din, "
+                             f"dout] projection (MoE expert stacks are not "
+                             f"LoRA-targetable)")
+        sub.update(leaves)
+        layers[target] = sub
+    out = dict(params)
+    out["layers"] = layers
+    return out

@@ -41,6 +41,7 @@ import numpy as np
 
 from aws_k8s_ansible_provisioner_tpu.config import ModelConfig, ServingConfig
 from aws_k8s_ansible_provisioner_tpu.models.layers import (
+    lora_context,
     model_forward,
     model_forward_carry,
 )
@@ -123,6 +124,10 @@ class Request:
     # force/ban, the documented semantics). Server normalizes the JSON map;
     # () = off. At most BIAS_K entries (submit() validates).
     logit_bias: tuple = ()
+    # Multi-LoRA (models/lora.py): name of an adapter registered at Engine
+    # construction, or None = base model. Any mix of adapters rides one
+    # continuous batch (per-slot index vector on every dispatch).
+    lora: Optional[str] = None
     # OpenAI ``response_format`` (serving/guided.py): a TokenGrammar (or
     # GuidedState) constraining every sampled token to the grammar's allowed
     # set. submit() wraps a bare grammar in a fresh per-request GuidedState.
@@ -294,7 +299,8 @@ def _restore_count_row(counts, slot, row):
 def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
                  temperature, top_k, top_p, logprobs: bool = False,
                  pages=None, seed=None, ban_ids=None, ban_until=None,
-                 bias_ids=None, bias_vals=None, rep=None, allow=None):
+                 bias_ids=None, bias_vals=None, rep=None, allow=None,
+                 lora_idx=None):
     """Prefill one prompt into one slot; returns (cache, first sampled token).
 
     tokens: [1, T] right-padded to a bucket; true_len: scalar valid length;
@@ -304,18 +310,20 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
     """
     T = tokens.shape[1]
     positions = jnp.arange(T, dtype=jnp.int32)[None, :]
-    if pages is not None:
-        # carry path: the pool stays in the layer scan's carry — the xs→ys
-        # restack buffer OOMed the batch-128 paged program on chip (r5)
-        attend = make_prefill_attend_paged_carry(pages, true_len,
-                                                 window=cfg.sliding_window)
-        logits, cache = model_forward_carry(params, cfg, tokens, positions,
-                                            cache, attend)
-    else:
-        attend = make_prefill_attend(slot, true_len,
-                                     window=cfg.sliding_window)
-        logits, cache = model_forward(params, cfg, tokens, positions, cache,
-                                      attend)
+    with lora_context(lora_idx):
+        if pages is not None:
+            # carry path: the pool stays in the layer scan's carry — the
+            # xs→ys restack buffer OOMed the batch-128 paged program on
+            # chip (r5)
+            attend = make_prefill_attend_paged_carry(
+                pages, true_len, window=cfg.sliding_window)
+            logits, cache = model_forward_carry(params, cfg, tokens,
+                                                positions, cache, attend)
+        else:
+            attend = make_prefill_attend(slot, true_len,
+                                         window=cfg.sliding_window)
+            logits, cache = model_forward(params, cfg, tokens, positions,
+                                          cache, attend)
     last = jnp.take(logits[0], true_len - 1, axis=0)[None]   # [1, V]
     last = _apply_prefill_repetition(last, tokens, true_len[None],
                                      rep[None] if rep is not None else None)
@@ -343,7 +351,8 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
                        slots, rng, temperature, top_k, top_p,
                        logprobs: bool = False, tables=None, seeds=None,
                        ban_ids=None, ban_until=None,
-                       bias_ids=None, bias_vals=None, reps=None, allow=None):
+                       bias_ids=None, bias_vals=None, reps=None, allow=None,
+                       lora_idx=None):
     """Prefill N prompts into N slots in ONE dispatch.
 
     tokens: [N, T] right-padded to a (row, length) bucket; true_lens/slots/
@@ -356,17 +365,18 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
     """
     N, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (N, T))
-    if tables is not None:
-        # carry path — see prefill_step's paged branch
-        attend = make_prefill_attend_batch_paged_carry(
-            tables, true_lens, window=cfg.sliding_window)
-        logits, cache = model_forward_carry(params, cfg, tokens, positions,
-                                            cache, attend)
-    else:
-        attend = make_prefill_attend_batch(slots, true_lens,
-                                           window=cfg.sliding_window)
-        logits, cache = model_forward(params, cfg, tokens, positions, cache,
-                                      attend)
+    with lora_context(lora_idx):
+        if tables is not None:
+            # carry path — see prefill_step's paged branch
+            attend = make_prefill_attend_batch_paged_carry(
+                tables, true_lens, window=cfg.sliding_window)
+            logits, cache = model_forward_carry(params, cfg, tokens,
+                                                positions, cache, attend)
+        else:
+            attend = make_prefill_attend_batch(slots, true_lens,
+                                               window=cfg.sliding_window)
+            logits, cache = model_forward(params, cfg, tokens, positions,
+                                          cache, attend)
     last = logits[jnp.arange(N), true_lens - 1]            # [N, V]
     last = _apply_prefill_repetition(last, tokens, true_lens, reps)
     if bias_ids is not None:
@@ -388,7 +398,7 @@ def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
                        logprobs: bool = False, pages=None, seed=None,
                        ban_ids=None, ban_until=None,
                        bias_ids=None, bias_vals=None, rep=None,
-                       rep_seen=None, allow=None):
+                       rep_seen=None, allow=None, lora_idx=None):
     """Prefill ONE chunk of a long prompt; decode interleaves between chunks.
 
     tokens: [1, C] (the chunk, right-padded on the final chunk); start: row
@@ -401,17 +411,18 @@ def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
     """
     C = tokens.shape[1]
     positions = start + jnp.arange(C, dtype=jnp.int32)[None, :]
-    if pages is not None:
-        # carry path — see prefill_step's paged branch
-        attend = make_chunk_prefill_attend_paged_carry(
-            pages, start, window=cfg.sliding_window)
-        logits, cache = model_forward_carry(params, cfg, tokens, positions,
-                                            cache, attend)
-    else:
-        attend = make_chunk_prefill_attend(slot, start,
-                                           window=cfg.sliding_window)
-        logits, cache = model_forward(params, cfg, tokens, positions, cache,
-                                      attend)
+    with lora_context(lora_idx):
+        if pages is not None:
+            # carry path — see prefill_step's paged branch
+            attend = make_chunk_prefill_attend_paged_carry(
+                pages, start, window=cfg.sliding_window)
+            logits, cache = model_forward_carry(params, cfg, tokens,
+                                                positions, cache, attend)
+        else:
+            attend = make_chunk_prefill_attend(slot, start,
+                                               window=cfg.sliding_window)
+            logits, cache = model_forward(params, cfg, tokens, positions,
+                                          cache, attend)
     last = jnp.take(logits[0], chunk_len - 1, axis=0)[None]  # [1, V]
     if rep is not None and rep_seen is not None:
         # chunks only carry a slice of the prompt: the seen-set over the
@@ -450,7 +461,7 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
                  repetition=None, prompt_mask=None,
                  penalties: bool = False, table=None, seeds=None,
                  ban_ids=None, ban_until=None, bias_ids=None,
-                 bias_vals=None, allow=None):
+                 bias_vals=None, allow=None, lora_idx=None):
     """``n_steps`` fused decode steps for every slot, one device dispatch.
 
     tokens/lengths/sampling params: [B]. Returns (cache, out [n_steps, B]).
@@ -520,8 +531,9 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
     if counts is None:
         counts = jnp.zeros((tokens.shape[0], 1), jnp.int32)  # unused dummy
     rngs = jax.random.split(rng, n_steps)
-    (cache, counts, _, _), out = jax.lax.scan(
-        body, (cache, counts, tokens, lengths), rngs)
+    with lora_context(lora_idx):
+        (cache, counts, _, _), out = jax.lax.scan(
+            body, (cache, counts, tokens, lengths), rngs)
     return cache, counts, out
 
 
@@ -529,7 +541,8 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
          donate_argnums=(3,))
 def spec_decode_step(cfg: ModelConfig, R: int, params, cache, tokens,
                      lengths, rng, temperature, top_k, top_p,
-                     impl: str = "auto", table=None, seeds=None, mesh=None):
+                     impl: str = "auto", table=None, seeds=None, mesh=None,
+                     lora_idx=None):
     """Speculative verify: R tokens per slot in ONE dispatch.
 
     tokens: [B, R] = [last accepted token, spec_k prompt-lookup drafts];
@@ -555,8 +568,9 @@ def spec_decode_step(cfg: ModelConfig, R: int, params, cache, tokens,
     else:
         attend = make_spec_attend_carry(lengths, impl=impl, mesh=mesh,
                                         window=cfg.sliding_window)
-    logits, cache = model_forward_carry(params, cfg, tokens, positions,
-                                        cache, attend)
+    with lora_context(lora_idx):
+        logits, cache = model_forward_carry(params, cfg, tokens, positions,
+                                            cache, attend)
     preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # [B, R]
     drafts = tokens[:, 1:]                                     # [B, R-1]
     match = (drafts == preds[:, :-1]).astype(jnp.int32)
@@ -584,7 +598,8 @@ class Engine:
     """Continuous-batching engine over a fixed set of decode slots."""
 
     def __init__(self, cfg: ModelConfig, params, serving: ServingConfig,
-                 eos_token_id: Optional[int] = None, mesh=None, draft=None):
+                 eos_token_id: Optional[int] = None, mesh=None, draft=None,
+                 lora=None):
         self.cfg = cfg
         self.params = params
         self.serving = serving
@@ -692,6 +707,23 @@ class Engine:
                     f"cache window {self.max_len} must split into 8-row-"
                     f"aligned sequence shards; not divisible by sp={sp} * 8")
             self.params = params = shard_params(params, self.mesh, cfg)
+        # Multi-LoRA (models/lora.py): adapters stack along a leading
+        # adapter axis and attach beside their target kernels, AFTER
+        # quantization (int8 kernels keep f32-loaded LoRA factors separate)
+        # — one compiled program serves every adapter mix via the per-slot
+        # index vector the dispatches carry.
+        self.lora_names: List[str] = []
+        if lora:
+            if self.mesh is not None:
+                raise ValueError("multi-LoRA under a mesh is not wired yet "
+                                 "(adapter-axis pspecs)")
+            from aws_k8s_ansible_provisioner_tpu.models import lora as _lora
+
+            items = list(lora.items())
+            loaded = [_lora.load_adapter(path) for _, path in items]
+            stacked = _lora.stack_adapters(loaded, cfg.num_layers, dtype)
+            self.params = params = _lora.attach(params, stacked)
+            self.lora_names = [name for name, _ in items]
         # True paged KV: shared page pool + block tables. Composes with tp
         # (and ep) meshes — the pool shards only its KV-HEAD axis, so page
         # identity, tables, and the host allocator are shard-invariant
@@ -883,6 +915,12 @@ class Engine:
         self.bias_ids = np.full((self.num_slots, BIAS_K), 2**31 - 1, np.int32)
         self.bias_vals = np.zeros((self.num_slots, BIAS_K), np.float32)
         self._bias_n = np.zeros(self.num_slots, np.int32)
+        # per-slot LoRA adapter index (0 = base); rides every dispatch when
+        # adapters are registered. _slot_lora mirrors the adapter whose
+        # projections produced each DENSE slot's retained rows — the dense
+        # prefix cache must never cross adapters (review r5).
+        self.lora_idx = np.zeros(self.num_slots, np.int32)
+        self._slot_lora = np.zeros(self.num_slots, np.int32)
         self.pres_pens = np.zeros(self.num_slots, np.float32)
         self.freq_pens = np.zeros(self.num_slots, np.float32)
         self.rep_pens = np.ones(self.num_slots, np.float32)
@@ -991,8 +1029,13 @@ class Engine:
             return None
         ids = req.prompt_ids
         cap = len(ids) - 1
+        req_lidx = (self.lora_names.index(req.lora) + 1
+                    if req.lora is not None else 0)
         best_n, best_s = 0, -1
         for s, toks in enumerate(self._slot_tokens):
+            if self._slot_lora[s] != req_lidx:
+                # rows were projected under a different adapter (review r5)
+                continue
             m = min(len(toks), cap)
             if m <= best_n:
                 continue
@@ -1068,7 +1111,10 @@ class Engine:
         matched: List[int] = []
         n = 0
         if self.serving.prefix_cache:
-            matched, n = allocator.lookup_prefix(ids)
+            req_lidx = (self.lora_names.index(req.lora) + 1
+                        if req.lora is not None else 0)
+            matched, n = allocator.lookup_prefix(
+                ids, salt=self._lora_salt(req_lidx))
             # the final token must run through prefill to produce the first
             # sampled token — cap reuse one token short of the prompt
             while n > len(ids) - 1:
@@ -1116,7 +1162,7 @@ class Engine:
         allocator = self._alloc(slot)
         pages = self._slot_pages[slot]
         n_valid = len(ids) if n_valid is None else n_valid
-        key = None
+        key = self._lora_salt(self.lora_idx[slot])
         for p in range(min(n_valid // ps, len(pages))):
             key = allocator.index_page(
                 pages[p], key, tuple(ids[p * ps:(p + 1) * ps]))
@@ -1205,6 +1251,7 @@ class Engine:
         self.ban_until[slot] = 0
         self.bias_ids[slot, :] = 2**31 - 1
         self.bias_vals[slot, :] = 0.0
+        self.lora_idx[slot] = 0
         self._bias_n[slot] = 0
         self._release_slot_pages(slot)
         self.sched.release(slot)
@@ -1260,6 +1307,9 @@ class Engine:
                 raise ValueError(
                     "min_tokens cannot combine with exact-match guided "
                     "decoding (guided_regex / guided_choice)")
+        if req.lora is not None and req.lora not in self.lora_names:
+            raise ValueError(f"unknown LoRA adapter {req.lora!r} "
+                             f"(registered: {self.lora_names})")
         budget = self.max_len - len(req.prompt_ids) - 1
         if req.max_tokens > budget:
             req.max_tokens = max(1, budget)
@@ -1303,6 +1353,8 @@ class Engine:
             self.ban_until[slot] = len(req.prompt_ids) + req.min_tokens
         else:
             self.ban_until[slot] = 0
+        self.lora_idx[slot] = (self.lora_names.index(req.lora) + 1
+                               if req.lora is not None else 0)
         self.bias_ids[slot, :] = 2**31 - 1
         self.bias_vals[slot, :] = 0.0
         n = len(req.logit_bias)
@@ -1320,6 +1372,18 @@ class Engine:
         words = req.guided.mask_words()
         aw[i, :] = 0
         aw[i, :len(words)] = words
+
+    def _lora_vec(self):
+        return jnp.asarray(self.lora_idx) if self.lora_names else None
+
+    def _lora_salt(self, idx: int):
+        """Prefix-cache identity component for a slot's adapter: KV rows
+        computed under adapter A must never prefix-hit a request running
+        adapter B or the base model — wq/wk/wv project differently per
+        adapter (review r5; vLLM folds lora_int_id into its block hash for
+        the same reason). None for the base keeps pre-LoRA hash chains
+        byte-compatible."""
+        return ("lora", int(idx)) if idx else None
 
     def _allow_row(self, req: Request):
         """[1, ceil(V/32)] guided allow-bitmask device array for one request,
@@ -1550,6 +1614,7 @@ class Engine:
             self._index_prompt_pages(slot, ids)
         else:
             self._slot_tokens[slot] = tuple(req.prompt_ids)
+            self._slot_lora[slot] = self.lora_idx[slot]
         self.slot_req[slot] = req
         # Resume: decode's next dispatch RE-writes last_token's K/V at row
         # ``lengths`` before attending, so point it at the last real token's
@@ -1638,7 +1703,9 @@ class Engine:
             bias_ids=jnp.asarray(self.bias_ids[slot]),
             bias_vals=jnp.asarray(self.bias_vals[slot]),
             rep=jnp.float32(req.repetition_penalty or 1.0),
-            allow=self._allow_row(req))
+            allow=self._allow_row(req),
+            lora_idx=(jnp.asarray(self.lora_idx[slot:slot + 1])
+                      if self.lora_names else None))
         lp = None
         if req.logprobs is not None:
             self.cache, token, lp_t = out
@@ -1692,6 +1759,7 @@ class Engine:
         bias_ids = np.full((n_bucket, BIAS_K), 2**31 - 1, np.int32)
         bias_vals = np.zeros((n_bucket, BIAS_K), np.float32)
         reps = np.ones(n_bucket, np.float32)
+        row_lora = np.zeros(n_bucket, np.int32)
         for i, (req, slot) in enumerate(batch):
             self._fill_sampling_rows(req, slot)
             ban_ids[i] = self.ban_ids[slot]
@@ -1699,6 +1767,7 @@ class Engine:
             bias_ids[i] = self.bias_ids[slot]
             bias_vals[i] = self.bias_vals[slot]
             reps[i] = req.repetition_penalty or 1.0
+            row_lora[i] = self.lora_idx[slot]
         allow = None
         if any(req.guided is not None for req, _ in batch):
             aw = np.full((n_bucket, (self.cfg.vocab_size + 31) // 32),
@@ -1716,7 +1785,8 @@ class Engine:
             logprobs=want_lp, tables=tables, seeds=jnp.asarray(seeds),
             ban_ids=jnp.asarray(ban_ids), ban_until=jnp.asarray(ban_until),
             bias_ids=jnp.asarray(bias_ids), bias_vals=jnp.asarray(bias_vals),
-            reps=jnp.asarray(reps), allow=allow)
+            reps=jnp.asarray(reps), allow=allow,
+            lora_idx=(jnp.asarray(row_lora) if self.lora_names else None))
         lp_t = None
         if want_lp:
             self.cache, toks, lp_t = out
@@ -1819,7 +1889,9 @@ class Engine:
                 bias_vals=jnp.asarray(self.bias_vals[slot]),
                 rep=jnp.float32(req.repetition_penalty or 1.0),
                 rep_seen=jnp.asarray(st["rep_seen"]),
-                allow=self._allow_row(req))
+                allow=self._allow_row(req),
+                lora_idx=(jnp.asarray(self.lora_idx[slot:slot + 1])
+                          if self.lora_names else None))
             if req.logprobs is not None and not st.get("resumed") \
                     and off + len(chunk) >= len(ids):
                 self.cache, token, lp_t = out
@@ -1929,7 +2001,8 @@ class Engine:
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
             jnp.asarray(self.top_ps), impl=self.serving.attention_impl,
             table=jnp.asarray(self.table) if self.paged else None,
-            seeds=jnp.asarray(self.seeds), mesh=self.mesh)
+            seeds=jnp.asarray(self.seeds), mesh=self.mesh,
+            lora_idx=self._lora_vec())
         out = np.asarray(out)
         accepted = np.asarray(accepted)
         dt = time.monotonic() - t0
@@ -2079,7 +2152,8 @@ class Engine:
             ban_until=jnp.asarray(self.ban_until),
             bias_ids=jnp.asarray(self.bias_ids),
             bias_vals=jnp.asarray(self.bias_vals),
-            allow=self._allow_words(gslots))
+            allow=self._allow_words(gslots),
+            lora_idx=self._lora_vec())
         # un-penalized dispatches return a dummy counts array — keep ours
         self.counts = new_counts if want_pen else real_counts
         lp_t = None
@@ -2195,6 +2269,7 @@ class Engine:
         self.bias_ids[slot, :] = 2**31 - 1
         self.bias_vals[slot, :] = 0.0
         self._bias_n[slot] = 0
+        self.lora_idx[slot] = 0
         self._release_slot_pages(slot)
         self.sched.release(slot)
         self.metrics.active_requests.set(len(self._active_slots()))
@@ -2329,7 +2404,8 @@ class Engine:
                     ban_ids=jnp.asarray(self.ban_ids),
                     ban_until=jnp.asarray(self.ban_until),
                     bias_ids=jnp.asarray(self.bias_ids),
-                    bias_vals=jnp.asarray(self.bias_vals))
+                    bias_vals=jnp.asarray(self.bias_vals),
+                    lora_idx=self._lora_vec())
             return
 
         # Distinct token values per warmup request — identical prompts would
@@ -2417,7 +2493,8 @@ class Engine:
             ban_ids=jnp.asarray(self.ban_ids),
             ban_until=jnp.asarray(self.ban_until),
             bias_ids=jnp.asarray(self.bias_ids),
-            bias_vals=jnp.asarray(self.bias_vals))
+            bias_vals=jnp.asarray(self.bias_vals),
+                    lora_idx=self._lora_vec())
         del cnts, mask
         # Logprobs program variants ('logprobs' is a static arg on every step
         # fn — distinct programs): one isolated request compiles the
@@ -2451,4 +2528,5 @@ class Engine:
             ban_ids=jnp.asarray(self.ban_ids),
             ban_until=jnp.asarray(self.ban_until),
             bias_ids=jnp.asarray(self.bias_ids),
-            bias_vals=jnp.asarray(self.bias_vals))
+            bias_vals=jnp.asarray(self.bias_vals),
+                    lora_idx=self._lora_vec())

@@ -390,7 +390,8 @@ class PagePool:
         self._hash_to_page[key] = pid
         return key
 
-    def lookup_prefix(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+    def lookup_prefix(self, prompt: Sequence[int],
+                      salt=None) -> Tuple[List[int], int]:
         """Longest chain of resident FULL pages matching the prompt's prefix.
 
         Returns (page_ids, n_tokens). Walks page-by-page — O(n_pages) hash
@@ -398,10 +399,14 @@ class PagePool:
         weak #5). Only complete pages match; the caller re-prefills the tail.
         Matched pages are NOT retained — callers must ``retain`` each page
         they actually use before any other allocation can evict it.
+
+        ``salt`` seeds the hash chain: pages written under different salts
+        (e.g. different LoRA adapters — their K/V projections differ even
+        for equal tokens) can never cross-match (review r5).
         """
         ps = self.page_size
         pages: List[int] = []
-        parent = None
+        parent = salt
         for p in range(len(prompt) // ps):
             toks = tuple(prompt[p * ps:(p + 1) * ps])
             key = self.chain_key(parent, toks)

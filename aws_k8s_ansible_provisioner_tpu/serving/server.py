@@ -156,16 +156,18 @@ class Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         path = self.path.split("?")[0]
         if path == "/v1/models":
-            self._json(200, {
-                "object": "list",
-                "data": [{
-                    "id": self.state.model_name,
-                    "object": "model",
-                    "created": self.state.started,
-                    "owned_by": "tpu-serve",
-                    "max_model_len": self.state.engine.max_len,
-                }],
-            })
+            base = {
+                "id": self.state.model_name,
+                "object": "model",
+                "created": self.state.started,
+                "owned_by": "tpu-serve",
+                "max_model_len": self.state.engine.max_len,
+            }
+            # LoRA adapters are served as model ids (the vLLM --enable-lora
+            # contract): request model == adapter name routes to it
+            adapters = [{**base, "id": name, "parent": self.state.model_name}
+                        for name in self.state.engine.lora_names]
+            self._json(200, {"object": "list", "data": [base] + adapters})
         elif path == "/metrics":
             # Engine metrics + per-chip HBM gauges from THIS process's
             # runtime (the engine owns the chips; the node exporter derives
@@ -277,9 +279,12 @@ class Handler(BaseHTTPRequestHandler):
     def _completions(self, body: dict, chat: bool):
         st = self.state
         model = body.get("model") or st.model_name
-        if model != st.model_name:
+        lora_name = model if model in st.engine.lora_names else None
+        if model != st.model_name and lora_name is None:
             return self._error(404, f"model {model!r} not found; serving "
-                                    f"{st.model_name!r}", "model_not_found")
+                                    f"{st.model_name!r} (adapters: "
+                                    f"{st.engine.lora_names})",
+                               "model_not_found")
 
         if chat:
             messages = body.get("messages")
@@ -475,6 +480,7 @@ class Handler(BaseHTTPRequestHandler):
                 repetition_penalty=repetition_penalty,
                 stop_token_ids=stop_token_ids, min_tokens=min_tokens,
                 logit_bias=logit_bias, guided=guided, ignore_eos=ignore_eos,
+                lora=lora_name,
                 seed=None if seed is None else seed + i,
                 **({"out_queue": _NotifyQueue(notify)} if notify else {}))
                 for i in range(best_of)]
@@ -490,19 +496,21 @@ class Handler(BaseHTTPRequestHandler):
 
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         if stream:
-            self._stream_response(reqs, rid, chat, stops,
+            self._stream_response(reqs, rid, chat, stops, model=model,
                                   n_prompt=len(prompt_ids),
                                   include_usage=include_usage,
                                   echo_text=prompt_text if echo else None,
                                   lp_k=lp_n)
         else:
             self._full_response(reqs, rid, chat, stops, len(prompt_ids),
+                                model=model,
                                 n_choices=n_choices,
                                 lp_requested=lp_n is not None,
                                 echo_text=prompt_text if echo else None)
 
     def _full_response(self, reqs, rid: str, chat: bool, stops: List[str],
-                       n_prompt: int, n_choices: Optional[int] = None,
+                       n_prompt: int = 0, model: Optional[str] = None,
+                       n_choices: Optional[int] = None,
                        lp_requested: bool = True,
                        echo_text: Optional[str] = None):
         """Collect finished candidates into the response. When ``reqs``
@@ -568,10 +576,12 @@ class Handler(BaseHTTPRequestHandler):
         self._json(200, {"id": rid,
                          "object": "chat.completion" if chat
                          else "text_completion",
-                         "created": _now(), "model": st.model_name,
+                         "created": _now(),
+                         "model": model or st.model_name,
                          "choices": choices, "usage": usage})
 
     def _stream_response(self, reqs, rid: str, chat: bool, stops: List[str],
+                         model: Optional[str] = None,
                          n_prompt: int = 0, include_usage: bool = False,
                          echo_text: Optional[str] = None,
                          lp_k: Optional[int] = None):
@@ -615,7 +625,8 @@ class Handler(BaseHTTPRequestHandler):
             if lp is not None:
                 payload["logprobs"] = lp
             body = {"id": rid, "object": obj, "created": _now(),
-                    "model": st.model_name, "choices": [payload]}
+                    "model": model or st.model_name,
+                    "choices": [payload]}
             if include_usage:
                 # OpenAI stream_options.include_usage: every content chunk
                 # carries usage: null; the final stats ride a dedicated
@@ -764,7 +775,7 @@ class Handler(BaseHTTPRequestHandler):
                 n_gen = sum(len(s["req"].generated) for s in states)
                 raw_write(("data: " + json.dumps({
                     "id": rid, "object": obj, "created": _now(),
-                    "model": st.model_name, "choices": [],
+                    "model": model or st.model_name, "choices": [],
                     "usage": {"prompt_tokens": n_prompt,
                               "completion_tokens": n_gen,
                               "total_tokens": n_prompt + n_gen},
@@ -882,9 +893,22 @@ def build_state(serving_cfg=None, model_cfg=None, params=None,
         draft = (draft_cfg, draft_params)
         log.info("draft model: %s (%s)", draft_cfg.name,
                  serving.draft_checkpoint_dir)
+    lora = None
+    if serving.lora_adapters:
+        lora = {}
+        for spec in serving.lora_adapters:
+            name, sep, path = spec.partition("=")
+            if not sep or not name or not path:
+                raise ValueError(f"--lora expects name=path, got {spec!r}")
+            if name in lora:
+                raise ValueError(f"duplicate LoRA adapter name {name!r}")
+            if name == serving.model:
+                raise ValueError(f"LoRA adapter name {name!r} would shadow "
+                                 f"the served base model id")
+            lora[name] = path
     engine = Engine(model_cfg, params, serving,
                     eos_token_id=tokenizer.eos_token_id, mesh=mesh,
-                    draft=draft)
+                    draft=draft, lora=lora)
     templater = ChatTemplater(model_cfg.name, tokenizer,
                               template_path=serving.chat_template or None)
     return ServerState(engine, tokenizer, templater, serving.model)
@@ -982,6 +1006,10 @@ def main(argv=None):
     p.add_argument("--draft-checkpoint-dir", default="",
                    help="HF checkpoint dir of the draft model "
                         "(spec_method=draft)")
+    p.add_argument("--lora", action="append", default=[],
+                   metavar="NAME=PATH",
+                   help="register a peft LoRA adapter dir, served as model "
+                        "id NAME (repeatable; vLLM --enable-lora parity)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
@@ -1026,6 +1054,7 @@ def main(argv=None):
         spec_decode=args.spec_decode, spec_k=args.spec_k,
         spec_method=args.spec_method,
         draft_checkpoint_dir=args.draft_checkpoint_dir,
+        lora_adapters=tuple(args.lora),
         mesh=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep))
     state = build_state(serving)
     if not args.no_warmup:
