@@ -63,6 +63,12 @@ def load_adapter(adapter_dir: str) -> dict:
     with open(cfg_path) as fh:
         acfg = json.load(fh)
     r = int(acfg["r"])
+    for unsupported in ("use_dora", "lora_bias"):
+        if acfg.get(unsupported):
+            # DoRA magnitudes / bias tensors change the adapter math; plain
+            # LoRA application would serve degraded outputs silently
+            raise ValueError(f"adapter {adapter_dir}: {unsupported} is not "
+                             f"supported")
     for patterned in ("alpha_pattern", "rank_pattern"):
         if acfg.get(patterned):
             # silently applying a uniform scale to per-module overrides
@@ -88,10 +94,16 @@ def load_adapter(adapter_dir: str) -> dict:
         if proj is None:
             raise ValueError(f"adapter targets an unsupported module: {key} "
                              f"(supported: {sorted(TARGET_MAP)})")
-        which = "A" if "lora_A" in key else "B"
+        if key.endswith("lora_A.weight"):
+            which = 0
+        elif key.endswith("lora_B.weight"):
+            which = 1
+        else:
+            raise ValueError(f"unsupported adapter tensor {key!r} (only "
+                             f"lora_A.weight / lora_B.weight)")
         slot = per_target.setdefault(TARGET_MAP[proj], {}) \
             .setdefault(layer, [None, None])
-        slot[0 if which == "A" else 1] = np.asarray(val, np.float32)
+        slot[which] = np.asarray(val, np.float32)
 
     out = {}
     for target, layers in per_target.items():

@@ -124,6 +124,12 @@ class Request:
     # force/ban, the documented semantics). Server normalizes the JSON map;
     # () = off. At most BIAS_K entries (submit() validates).
     logit_bias: tuple = ()
+    # vLLM ``prompt_logprobs`` (also powers OpenAI legacy echo+logprobs):
+    # None = off; int K = per-PROMPT-position logprob of the actual token
+    # plus top-K alternatives (position 0 is None). Disables prefix-cache
+    # reuse for the request (reused rows skip prefill, which is where these
+    # are computed) and rejects prompts that need chunking.
+    prompt_logprobs: object = None
     # Multi-LoRA (models/lora.py): name of an adapter registered at Engine
     # construction, or None = base model. Any mix of adapters rides one
     # continuous batch (per-slot index vector on every dispatch).
@@ -139,6 +145,10 @@ class Request:
     generated: List[int] = field(default_factory=list)
     # per generated token: (own logprob, [(token_id, logprob) x k])
     logprob_data: List[tuple] = field(default_factory=list)
+    # per PROMPT position: None (position 0) or (own logprob,
+    # [(token_id, logprob) x k]) — filled at activation when
+    # prompt_logprobs is requested
+    prompt_logprob_data: List = field(default_factory=list)
     out_queue: "queue.Queue" = field(default_factory=queue.Queue)
     t_submit: float = 0.0
     t_first_token: float = 0.0
@@ -254,6 +264,31 @@ def _logprob_topk(logits: jnp.ndarray, chosen: jnp.ndarray):
     return sel, vals, ids.astype(jnp.int32)
 
 
+def _prompt_logprobs(logits, tokens):
+    """Per-position PROMPT logprobs (vLLM ``prompt_logprobs`` / OpenAI
+    legacy echo+logprobs): entry t scores prompt token t+1 given tokens
+    <= t (position 0 has no logprob, the OpenAI None convention).
+
+    Sequential ``lax.map`` over positions: one [N, V] log_softmax + top-k
+    at a time — materializing the full [N, T, V] f32 log-softmax would hold
+    gigabytes at large buckets. Returns (sel [N, T-1], vals [N, T-1, K],
+    ids [N, T-1, K])."""
+    lg = jnp.swapaxes(logits[:, :-1], 0, 1)      # [T-1, N, V]
+    nxt = jnp.swapaxes(tokens[:, 1:], 0, 1)      # [T-1, N]
+
+    def per_pos(args):
+        lg_t, tok = args
+        lp = jax.nn.log_softmax(lg_t.astype(jnp.float32), -1)
+        sel = jnp.take_along_axis(lp, tok[:, None].astype(jnp.int32),
+                                  1)[:, 0]
+        vals, ids = jax.lax.top_k(lp, min(LOGPROB_K, lp.shape[-1]))
+        return sel, vals, ids.astype(jnp.int32)
+
+    sel, vals, ids = jax.lax.map(per_pos, (lg, nxt))
+    return (jnp.swapaxes(sel, 0, 1), jnp.swapaxes(vals, 0, 1),
+            jnp.swapaxes(ids, 0, 1))
+
+
 def _host_lp(lp_t, row: int, k: int):
     """Slice one row of a device (sel, vals, ids) triple into the host-side
     per-token logprob record: (own_logprob, [(token_id, logprob) x k])."""
@@ -294,13 +329,14 @@ def _restore_count_row(counts, slot, row):
         counts, row[None].astype(counts.dtype), (slot, jnp.int32(0)))
 
 
-@partial(jax.jit, static_argnums=(0,), static_argnames=("logprobs",),
+@partial(jax.jit, static_argnums=(0,),
+         static_argnames=("logprobs", "prompt_logprobs"),
          donate_argnums=(2,))
 def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
                  temperature, top_k, top_p, logprobs: bool = False,
                  pages=None, seed=None, ban_ids=None, ban_until=None,
                  bias_ids=None, bias_vals=None, rep=None, allow=None,
-                 lora_idx=None):
+                 lora_idx=None, prompt_logprobs: bool = False):
     """Prefill one prompt into one slot; returns (cache, first sampled token).
 
     tokens: [1, T] right-padded to a bucket; true_len: scalar valid length;
@@ -340,19 +376,23 @@ def prefill_step(cfg: ModelConfig, params, cache, tokens, true_len, slot, rng,
         else rng
     token = sample(last, keys, temperature[None], top_k[None],
                    top_p[None])[0]
+    out = [cache, token]
     if logprobs:
-        return cache, token, _logprob_topk(last, token[None])
-    return cache, token
+        out.append(_logprob_topk(last, token[None]))
+    if prompt_logprobs:
+        out.append(_prompt_logprobs(logits[:1], tokens))
+    return tuple(out)
 
 
-@partial(jax.jit, static_argnums=(0,), static_argnames=("logprobs",),
+@partial(jax.jit, static_argnums=(0,),
+         static_argnames=("logprobs", "prompt_logprobs"),
          donate_argnums=(2,))
 def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
                        slots, rng, temperature, top_k, top_p,
                        logprobs: bool = False, tables=None, seeds=None,
                        ban_ids=None, ban_until=None,
                        bias_ids=None, bias_vals=None, reps=None, allow=None,
-                       lora_idx=None):
+                       lora_idx=None, prompt_logprobs: bool = False):
     """Prefill N prompts into N slots in ONE dispatch.
 
     tokens: [N, T] right-padded to a (row, length) bucket; true_lens/slots/
@@ -386,9 +426,12 @@ def prefill_batch_step(cfg: ModelConfig, params, cache, tokens, true_lens,
     last = _apply_allow(last, allow)
     keys = per_slot_keys(seeds, true_lens) if seeds is not None else rng
     toks = sample(last, keys, temperature, top_k, top_p)
+    out = [cache, toks]
     if logprobs:
-        return cache, toks, _logprob_topk(last, toks)
-    return cache, toks
+        out.append(_logprob_topk(last, toks))
+    if prompt_logprobs:
+        out.append(_prompt_logprobs(logits, tokens))
+    return tuple(out)
 
 
 @partial(jax.jit, static_argnums=(0,), static_argnames=("logprobs",),
@@ -1025,7 +1068,7 @@ class Engine:
         the request (for the dispatch-economics gate; matching it means the
         rows are already in place and reuse is free).
         """
-        if not self.serving.prefix_cache:
+        if not self.serving.prefix_cache or req.prompt_logprobs is not None:
             return None
         ids = req.prompt_ids
         cap = len(ids) - 1
@@ -1110,7 +1153,7 @@ class Engine:
         allocator = self._alloc(slot)
         matched: List[int] = []
         n = 0
-        if self.serving.prefix_cache:
+        if self.serving.prefix_cache and req.prompt_logprobs is None:
             req_lidx = (self.lora_names.index(req.lora) + 1
                         if req.lora is not None else 0)
             matched, n = allocator.lookup_prefix(
@@ -1307,6 +1350,14 @@ class Engine:
                 raise ValueError(
                     "min_tokens cannot combine with exact-match guided "
                     "decoding (guided_regex / guided_choice)")
+        if req.prompt_logprobs is not None:
+            if not (0 <= int(req.prompt_logprobs) <= LOGPROB_K):
+                raise ValueError(f"prompt_logprobs must be in "
+                                 f"[0, {LOGPROB_K}]")
+            if self._should_chunk(req):
+                raise ValueError(
+                    "prompt_logprobs is not supported for prompts that "
+                    "need chunked prefill (fits-in-bucket prompts only)")
         if req.lora is not None and req.lora not in self.lora_names:
             raise ValueError(f"unknown LoRA adapter {req.lora!r} "
                              f"(registered: {self.lora_names})")
@@ -1681,6 +1732,20 @@ class Engine:
         else:
             self._emit(slot, token, lp)
 
+    @staticmethod
+    def _host_prompt_lp(req: Request, plp, row: int, n_prompt: int) -> None:
+        """Format one row of a device (sel, vals, ids) prompt-logprob
+        triple into req.prompt_logprob_data ([None, (own, [(id, lp) x k]),
+        ...]) — ONE bulk transfer, pure numpy slicing after."""
+        sel, vals, ids = (np.asarray(a) for a in plp)
+        k = int(req.prompt_logprobs)
+        data: List = [None]
+        for t in range(1, n_prompt):
+            pairs = [(int(ids[row, t - 1, j]), float(vals[row, t - 1, j]))
+                     for j in range(k)]
+            data.append((float(sel[row, t - 1]), pairs))
+        req.prompt_logprob_data = data
+
     def _do_prefill(self, req: Request, slot: int):
         if not self.paged:
             self._slot_tokens[slot] = ()   # rows about to be overwritten
@@ -1705,13 +1770,17 @@ class Engine:
             rep=jnp.float32(req.repetition_penalty or 1.0),
             allow=self._allow_row(req),
             lora_idx=(jnp.asarray(self.lora_idx[slot:slot + 1])
-                      if self.lora_names else None))
+                      if self.lora_names else None),
+            prompt_logprobs=req.prompt_logprobs is not None)
+        items = list(out)
+        self.cache, token = items[0], items[1]
+        pos = 2
         lp = None
         if req.logprobs is not None:
-            self.cache, token, lp_t = out
-            lp = _host_lp(lp_t, 0, req.logprobs)
-        else:
-            self.cache, token = out
+            lp = _host_lp(items[pos], 0, req.logprobs)
+            pos += 1
+        if req.prompt_logprobs is not None:
+            self._host_prompt_lp(req, items[pos], 0, len(ids))
         token = int(token)  # device sync
         self.metrics.device_busy_seconds.inc(time.monotonic() - t0)
         if self.draft is not None:
@@ -1778,6 +1847,7 @@ class Engine:
             allow = jnp.asarray(aw)
         t0 = time.monotonic()
         want_lp = self._want_logprobs([r for r, _ in batch])
+        want_plp = any(r.prompt_logprobs is not None for r, _ in batch)
         out = prefill_batch_step(
             self.cfg, self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(true_lens), jnp.asarray(slots), self._next_rng(),
@@ -1786,13 +1856,17 @@ class Engine:
             ban_ids=jnp.asarray(ban_ids), ban_until=jnp.asarray(ban_until),
             bias_ids=jnp.asarray(bias_ids), bias_vals=jnp.asarray(bias_vals),
             reps=jnp.asarray(reps), allow=allow,
-            lora_idx=(jnp.asarray(row_lora) if self.lora_names else None))
+            lora_idx=(jnp.asarray(row_lora) if self.lora_names else None),
+            prompt_logprobs=want_plp)
+        items = list(out)
+        self.cache, toks = items[0], items[1]
+        pos = 2
         lp_t = None
         if want_lp:
-            self.cache, toks, lp_t = out
-            lp_t = tuple(np.asarray(a) for a in lp_t)  # ONE bulk transfer
-        else:
-            self.cache, toks = out
+            lp_t = tuple(np.asarray(a) for a in items[pos])  # ONE transfer
+            pos += 1
+        plp_t = tuple(np.asarray(a) for a in items[pos]) \
+            if want_plp else None                        # ONE bulk transfer
         toks = np.asarray(toks)  # device sync
         self.metrics.device_busy_seconds.inc(time.monotonic() - t0)
         if self.draft is not None:
@@ -1800,6 +1874,8 @@ class Engine:
         for i, (req, slot) in enumerate(batch):
             lp = _host_lp(lp_t, i, req.logprobs) \
                 if req.logprobs is not None else None
+            if req.prompt_logprobs is not None:
+                self._host_prompt_lp(req, plp_t, i, len(req.prompt_ids))
             self._activate(req, slot, int(toks[i]), lp)
 
     def _start_chunk(self, req: Request, slot: int, pref):
@@ -2503,11 +2579,14 @@ class Engine:
         # logprobs=N request pays the same all-streams XLA freeze the
         # penalties warmup exists to prevent (ADVICE r2, medium).
         self.submit(Request(prompt_ids=[3] * 4, max_tokens=max(2, horizon + 1),
-                            ignore_eos=True, logprobs=0))
+                            ignore_eos=True, logprobs=0, prompt_logprobs=0))
         drain()
         if nb > 1:
+            # one plp row in the burst also compiles the batched
+            # prompt-logprob variant (echo+logprobs implies it — review r5)
             rs = [Request(prompt_ids=[5] * 4, max_tokens=1, ignore_eos=True,
-                          logprobs=0) for _ in range(nb)]
+                          logprobs=0, prompt_logprobs=0 if i == 0 else None)
+                  for i in range(nb)]
             for r in rs:
                 self.submit(r)
             drain()

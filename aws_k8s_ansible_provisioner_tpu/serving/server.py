@@ -385,6 +385,14 @@ class Handler(BaseHTTPRequestHandler):
             return self._error(400, "best_of > n with stream=true is not "
                                     "supported (ranking needs complete "
                                     "candidates)")
+        # vLLM ``prompt_logprobs``: per-prompt-position logprobs (position
+        # 0 is null). OpenAI legacy echo+logprobs implies it (the prompt
+        # part of the echoed logprobs payload).
+        raw_plp = body.get("prompt_logprobs")
+        try:
+            plp = None if raw_plp is None else int(raw_plp)
+        except (TypeError, ValueError):
+            return self._error(400, "'prompt_logprobs' must be an integer")
         # OpenAI logprobs: completions take an int ``logprobs`` (0 = chosen-
         # token only — still enabled; absent/null = off); chat takes
         # ``logprobs: true`` + ``top_logprobs: N`` (explicit 0 respected).
@@ -409,6 +417,13 @@ class Handler(BaseHTTPRequestHandler):
             return self._error(400, "'logprobs' must be numeric")
         if lp_n is not None and (lp_n < 0 or lp_n > LOGPROB_K):
             return self._error(400, f"logprobs must be in [0, {LOGPROB_K}]")
+        if plp is not None:
+            if not (0 <= plp <= LOGPROB_K):
+                return self._error(400, f"prompt_logprobs must be in "
+                                        f"[0, {LOGPROB_K}]")
+            if stream:
+                return self._error(400, "prompt_logprobs with stream=true "
+                                        "is not supported")
         # OpenAI ``logit_bias``: {token_id: bias} map, additive on logits
         # before every sampling decision (±100 act as force/ban). vLLM
         # behind the reference's gateway accepts it; BIAS_K caps entries.
@@ -458,6 +473,13 @@ class Handler(BaseHTTPRequestHandler):
         prompt_ids = st.tokenizer.encode(prompt_text)
         if not prompt_ids:
             prompt_ids = [st.engine.eos_token_id]
+        if echo and lp_n is not None and plp is None and not stream \
+                and len(prompt_ids) <= max(st.engine.buckets or (0,)):
+            # OpenAI legacy echo+logprobs implies prompt logprobs — but only
+            # when the request can honor them (non-stream, bucket-sized
+            # prompt); otherwise keep the pre-r5 generated-only payload
+            # instead of breaking previously-working requests (review r5)
+            plp = lp_n
         # best_of ranking needs each candidate's chosen-token logprobs; ask
         # the engine for them even when the client didn't (the response
         # strips them again — lp_requested below).
@@ -480,7 +502,7 @@ class Handler(BaseHTTPRequestHandler):
                 repetition_penalty=repetition_penalty,
                 stop_token_ids=stop_token_ids, min_tokens=min_tokens,
                 logit_bias=logit_bias, guided=guided, ignore_eos=ignore_eos,
-                lora=lora_name,
+                lora=lora_name, prompt_logprobs=plp,
                 seed=None if seed is None else seed + i,
                 **({"out_queue": _NotifyQueue(notify)} if notify else {}))
                 for i in range(best_of)]
@@ -558,6 +580,25 @@ class Handler(BaseHTTPRequestHandler):
                     st.tokenizer, ids, req.logprob_data, req.logprobs, chat,
                     text_len=len(text) if cut is not None else -1,
                     base_offset=len(echo_text) if echo_text else 0)
+            if echo_text is not None and req.prompt_logprob_data \
+                    and lp_obj is not None and not chat:
+                # OpenAI legacy echo+logprobs: the payload covers PROMPT +
+                # generated; position 0 carries null (no context to score)
+                ptoks = [st.tokenizer.decode([i]) for i in req.prompt_ids]
+                poffs, p0 = [], 0
+                for t in ptoks:
+                    poffs.append(p0)
+                    p0 += len(t)
+                tail = req.prompt_logprob_data[1:]
+                k = req.logprobs or 0
+                pown = [None] + [d[0] for d in tail]
+                ptop = [None] + [
+                    {st.tokenizer.decode([tid]): v for tid, v in d[1][:k]}
+                    for d in tail]
+                lp_obj = {"tokens": ptoks + lp_obj["tokens"],
+                          "token_logprobs": pown + lp_obj["token_logprobs"],
+                          "top_logprobs": ptop + lp_obj["top_logprobs"],
+                          "text_offset": poffs + lp_obj["text_offset"]}
             if echo_text is not None:
                 text = echo_text + text
             if chat:
@@ -569,6 +610,16 @@ class Handler(BaseHTTPRequestHandler):
             else:
                 choice = {"index": idx, "text": text, "logprobs": lp_obj,
                           "finish_reason": finish}
+            if req.prompt_logprob_data:
+                # vLLM-style field: list over prompt positions; each entry
+                # maps decoded token -> logprob (chosen + top-k)
+                pl = [None]
+                for t, d in enumerate(req.prompt_logprob_data[1:], start=1):
+                    entry = {st.tokenizer.decode([req.prompt_ids[t]]): d[0]}
+                    for tid, v in d[1][:req.prompt_logprobs or 0]:
+                        entry.setdefault(st.tokenizer.decode([tid]), v)
+                    pl.append(entry)
+                choice["prompt_logprobs"] = pl
             choices.append(choice)
         usage = {"prompt_tokens": n_prompt,
                  "completion_tokens": completion_tokens,

@@ -127,7 +127,7 @@ def test_chat_logprobs_payload(server):
                        {"model": "tiny-qwen3",
                         "messages": [{"role": "user", "content": "hello"}],
                         "max_tokens": 4, "logprobs": True,
-                        "top_logprobs": 3})
+                        "top_logprobs": 3, "ignore_eos": True})
     assert code == 200
     content = body["choices"][0]["logprobs"]["content"]
     assert len(content) == 4

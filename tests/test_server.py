@@ -332,15 +332,21 @@ def test_echo_prepends_prompt(server):
     assert body2["choices"][0]["text"] == prompt + plain
 
 
-def test_echo_with_logprobs_offsets_past_prompt(server):
+def test_echo_with_logprobs_covers_prompt_then_generated(server):
+    """OpenAI legacy echo+logprobs: the payload now spans PROMPT +
+    generated (r5 prompt_logprobs); position 0 is null and the generated
+    tokens' offsets continue past the echoed prompt text."""
     prompt = "offsets"
     _, body = _post(server + "/v1/completions", {
         "model": MODEL_NAME, "prompt": prompt, "max_tokens": 4,
-        "echo": True, "logprobs": 1})
+        "echo": True, "logprobs": 1, "ignore_eos": True})
     lp = body["choices"][0]["logprobs"]
-    # completion-token offsets start after the echoed prompt text
-    assert lp["text_offset"][0] == len(prompt)
-    assert len(lp["tokens"]) == 4
+    n = len(prompt)
+    assert len(lp["tokens"]) == n + 4
+    assert lp["token_logprobs"][0] is None
+    assert all(isinstance(v, float) for v in lp["token_logprobs"][1:])
+    assert lp["text_offset"][0] == 0
+    assert lp["text_offset"][n] == len(prompt)
 
 
 def test_echo_rejected_on_chat(server):
