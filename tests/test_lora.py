@@ -253,3 +253,26 @@ def test_prefix_cache_never_crosses_adapters(tmp_path, paged):
     if paged:
         assert eng.metrics.prefix_cache_hits.total() > hits0, \
             "same-adapter reuse should still prefix-hit"
+
+
+def test_spec_decode_verifies_with_adapter(tmp_path):
+    """The spec verify dispatch carries the slot's adapter index: a
+    repetitive greedy prompt under prompt-lookup speculation must emit the
+    adapter's exact plain-decode stream (a base-model verify would accept
+    different tokens), with drafts actually proposed."""
+    import dataclasses
+
+    params = init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
+    path = _write_adapter(tmp_path, "ad", CFG, seed=4)
+    pat = [5, 6, 7]
+    prompt = pat * 5
+    base_cfg = _serving()
+    plain = Engine(CFG, params, base_cfg, lora={"ad": path})
+    ref = _stream(plain, prompt, n=20, lora="ad")
+
+    spec_cfg = dataclasses.replace(base_cfg, spec_decode=True, spec_k=4,
+                                   spec_ngram=3)
+    eng = Engine(CFG, params, spec_cfg, lora={"ad": path})
+    got = _stream(eng, prompt, n=20, lora="ad")
+    assert got == ref, "spec verify diverged under the adapter"
+    assert eng.metrics.spec_drafted_tokens.total() > 0
