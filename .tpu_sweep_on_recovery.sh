@@ -1,11 +1,15 @@
 #!/usr/bin/env bash
 # Fired by the session watcher the moment the TPU tunnel recovers: runs the
-# prioritized round-5 sweep (VERDICT r4 next #1/#2) and commits artifacts.
-# Priorities: (1) does the shipped paged path run on-chip at any batch?
-# (2) int8 weights A/B (roofline lever), (3) batch/horizon ceiling pushes.
+# prioritized round-6 sweep (VERDICT r5 next #1/#2/#3) and commits artifacts.
+# Priorities: (1) the double-buffered paged kernel's bblock curve at the
+# shipped default config (paged + int8 KV + int8 weights) — the PERF.md
+# model predicts a 14.3k -> 1.8k DMA-step reduction at bb=8; (2) the
+# autotuner's own pick (TPU_BENCH_BBLOCK unset => engine autotune, the
+# production path); (3) bf16-weights A/B (the opt-out direction, now that
+# int8 is default); (4) the TTFT prefill-lever curve.
 set -u
 cd /root/repo
-OUT=bench_sweep_r5.jsonl
+OUT=bench_sweep_r6.jsonl
 : > "$OUT"
 run() {
     local label="$1"; shift
@@ -24,14 +28,20 @@ run() {
     fi
     echo "--- $label done" >&2
 }
-run paged_carry    TPU_BENCH_PAGED=1
-run bb8_b128       TPU_BENCH_PAGED=0 PALLAS_DECODE_BBLOCK=8
-run bb16_b128      TPU_BENCH_PAGED=0 PALLAS_DECODE_BBLOCK=16
-run paged_b64      TPU_BENCH_PAGED=1 TPU_BENCH_BATCH=64
-run w8_bb8_b128    TPU_BENCH_PAGED=0 PALLAS_DECODE_BBLOCK=8 TPU_BENCH_WEIGHTS=int8
-run dense_b192_bb8 TPU_BENCH_PAGED=0 TPU_BENCH_BATCH=192 PALLAS_DECODE_BBLOCK=8
-run dense_h128     TPU_BENCH_PAGED=0 TPU_BENCH_BATCH=128 TPU_BENCH_HORIZON=128 PALLAS_DECODE_BBLOCK=8
-run w8_b128        TPU_BENCH_PAGED=0 TPU_BENCH_WEIGHTS=int8
-run paged_ps256    TPU_BENCH_PAGED=1 TPU_BENCH_PAGE_SIZE=256
-run paged_b96      TPU_BENCH_PAGED=1 TPU_BENCH_BATCH=96
+# 1) shipped default exactly as production serves it: paged, int8 weights
+#    (now the config default), engine autotunes bb — THE headline candidate
+run shipped_autotune TPU_BENCH_PAGED=1
+# 2) the bblock curve the autotuner chooses over (pins per point)
+run paged_bb1        TPU_BENCH_PAGED=1 TPU_BENCH_BBLOCK=1
+run paged_bb4        TPU_BENCH_PAGED=1 TPU_BENCH_BBLOCK=4
+run paged_bb8        TPU_BENCH_PAGED=1 TPU_BENCH_BBLOCK=8
+# 3) weights A/B (bf16 = the explicit opt-out) + dense control at bb=8
+run paged_bb8_wbf16  TPU_BENCH_PAGED=1 TPU_BENCH_BBLOCK=8 TPU_BENCH_WEIGHTS=bf16
+run dense_bb8        TPU_BENCH_PAGED=0 TPU_BENCH_BBLOCK=8
+# 4) capacity/geometry pushes at the winning block
+run paged_bb8_b64    TPU_BENCH_PAGED=1 TPU_BENCH_BBLOCK=8 TPU_BENCH_BATCH=64
+run paged_bb8_ps128  TPU_BENCH_PAGED=1 TPU_BENCH_BBLOCK=8 TPU_BENCH_PAGE_SIZE=128
+# 5) TTFT prefill levers (VERDICT next #3: the 2,408 ms number -> a curve)
+run ttft_pb16        TPU_BENCH_PAGED=1 TPU_BENCH_BBLOCK=8 TPU_BENCH_PREFILL_BATCH=16
+run ttft_pb32_chunk  TPU_BENCH_PAGED=1 TPU_BENCH_BBLOCK=8 TPU_BENCH_PREFILL_BATCH=32 TPU_BENCH_PREFILL_CHUNK=256
 echo "SWEEP COMPLETE" >&2

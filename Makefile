@@ -1,0 +1,32 @@
+# Developer/CI entry points. The heavy lifting lives in bench.py /
+# bench_sweep.py / deploy/*; these targets pin the hardware-free invocations
+# so CI and laptops run the same commands.
+
+PY ?= python
+
+.PHONY: test bench-smoke bench-dry ttft-sweep
+
+# The tier-1 gate's shape (serial, CPU, slow tests excluded).
+test:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		-p no:cacheprovider
+
+# One decode step through the SHIPPED bench program family (paged pool +
+# double-buffered bblock Pallas kernels + int8 weights) under
+# JAX_PLATFORMS=cpu: catches program-construction regressions in seconds,
+# no hardware. Tier-1 also runs these tests; this target is the focused
+# pre-push check after touching the kernel/engine decode path.
+bench-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m bench_smoke \
+		-p no:cacheprovider
+
+# Full bench field-plumbing proof on CPU (tiny model, ~15 s): one JSON line
+# with every real-run field (bblock, weights_dtype, dma_steps_per_substep,
+# last_tpu, roofline names).
+bench-dry:
+	$(PY) bench.py --dry
+
+# TTFT prefill-lever curve on the real chip (prefill batch x chunked
+# interleave; see bench_sweep.TTFT_GRID).
+ttft-sweep:
+	$(PY) bench_sweep.py --ttft

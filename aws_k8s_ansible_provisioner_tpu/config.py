@@ -698,12 +698,23 @@ class ServingConfig:
     # engine inside the reference's serving pods ships the same knob as
     # ``kv_cache_dtype``. See serving/kv_cache.py.
     kv_dtype: str = "auto"
-    # Weight storage dtype: "auto" keeps ``dtype``; "int8" applies weights-
-    # only per-out-channel quantization at engine start (models/quant.py) —
-    # half the weight HBM stream, the dominant bytes/token term below batch
-    # ~64 (PERF.md roofline). Compute stays bf16 on the MXU; the vLLM engine
-    # inside the reference's pods ships this as ``--quantization``.
-    weights_dtype: str = "auto"
+    # Weight storage dtype. "int8" is the SHIPPED DEFAULT (r6): weights-only
+    # per-out-channel quantization at engine start (models/quant.py) halves
+    # the weight HBM stream — the dominant bytes/token term below batch ~64
+    # (PERF.md roofline) — while compute stays bf16 on the MXU; the vLLM
+    # engine inside the reference's pods ships this as ``--quantization``.
+    # "bf16" (alias "auto") is the explicit full-precision opt-out for
+    # accuracy-sensitive deployments and exact-parity harnesses.
+    weights_dtype: str = "int8"
+    # Decode kernel batch-block: slots sharing one grid step of the
+    # double-buffered paged flash-decode kernel (BBx larger page DMAs, BBx
+    # fewer grid steps — ops/pallas_attention._paged_db_body). 0 = autotune
+    # at engine start: a one-shot deterministic microbench over {1, 4, 8}
+    # per (batch, page_size, kv_dtype), cached process-wide, TPU-only (CPU
+    # and meshes stay at 1). A positive value pins it (clamped to the
+    # largest divisor of max_decode_slots); the PALLAS_DECODE_BBLOCK env var
+    # overrides both for A/B sweeps.
+    decode_bblock: int = 0
     # Attention backend: "xla" (fused SDPA fallback) or "pallas" (custom kernel).
     attention_impl: str = "auto"
     checkpoint_dir: str = ""

@@ -68,7 +68,8 @@ def resolve_impl(impl: str = "auto") -> str:
 
 
 def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
-                             mesh=None, window: int = 0):
+                             mesh=None, window: int = 0,
+                             bblock: int = None):
     """Carry-path decode attend: cache_l is ``(full_cache, layer_idx)``.
 
     Used with ``models.layers.model_forward_carry`` — the full stacked cache
@@ -142,7 +143,7 @@ def make_decode_attend_carry(lengths: jnp.ndarray, impl: str = "auto",
         if sp == 1:
             ctx = pallas_attention.decode_attend_pallas_layer(
                 q, ck, cv, r_lens, layer, interpret=interpret,
-                window=window, **scale_kw)
+                window=window, bblock=bblock, **scale_kw)
             return ctx, cache
         # sp > 1 with a sliding window is rejected at Engine init: the
         # window straddles shard boundaries and the partial merge would
@@ -443,7 +444,7 @@ def make_prefill_attend(slot: jnp.ndarray, seq_len: jnp.ndarray,
 
 def make_decode_attend_carry_paged(lengths: jnp.ndarray, table: jnp.ndarray,
                                    impl: str = "auto", mesh=None,
-                                   window: int = 0):
+                                   window: int = 0, bblock: int = 1):
     """Carry-path decode attend over the PAGED pool: cache_l is
     ``(pool, layer_idx)``; ``table`` [B, max_pages] int32 maps each slot's
     logical pages to physical pool pages. The engine guarantees every row in
@@ -488,7 +489,7 @@ def make_decode_attend_carry_paged(lengths: jnp.ndarray, table: jnp.ndarray,
             scale_kw = {}
         ctx = pallas_attention.decode_attend_pallas_paged(
             q, ck, cv, lens + 1, layer, tab, interpret=interpret,
-            window=window, **scale_kw)
+            window=window, bblock=bblock, **scale_kw)
         return ctx, pool
 
     def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, tuple]:
@@ -538,7 +539,7 @@ def make_decode_attend_carry_paged(lengths: jnp.ndarray, table: jnp.ndarray,
 
 def make_spec_attend_carry_paged(lengths: jnp.ndarray, table: jnp.ndarray,
                                  impl: str = "auto", mesh=None,
-                                 window: int = 0):
+                                 window: int = 0, bblock: int = 1):
     """Paged speculative verify: R rows written across pages, one flash pass
     answers all R queries (pages covering lengths + R pre-allocated by the
     engine). With a ``mesh``, the pool's head axis shards over ``tp`` and the
@@ -582,7 +583,7 @@ def make_spec_attend_carry_paged(lengths: jnp.ndarray, table: jnp.ndarray,
             scale_kw = {}
         ctx = pallas_attention.decode_attend_pallas_spec_paged(
             q, ck, cv, lens, layer, tab, interpret=interpret,
-            window=window, **scale_kw)
+            window=window, bblock=bblock, **scale_kw)
         return ctx, pool
 
     def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, tuple]:

@@ -833,180 +833,306 @@ def cache_write_row_quant(cache: jnp.ndarray, scales: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
-# Paged variants: physical page pool + per-slot block tables
+# Paged variants: physical page pool + per-slot block tables, DOUBLE-BUFFERED
 # ---------------------------------------------------------------------------
 #
 # The dense kernels above address chunk c of slot b at cache[(lay, b, :,
 # c*CHUNK:(c+1)*CHUNK)] — an IDENTITY block table (kv_cache.pages_view). The
-# paged variants below are the same flash bodies with ONE change: the block
-# table arrives as a third scalar-prefetch operand and the index_map fetches
-# physical page ``table[b, c]`` from the pool [L, P, Hkv, page, D]
-# (serving/paged_kv.py). chunk == page_size, the grid's logical page axis is
-# the table width, and the DMA-skip clamp works unchanged: a dead logical
-# page clamps to the last live one, whose repeated PHYSICAL index suppresses
-# the re-fetch. This is the TPU analogue of vLLM's paged-attention block
-# indirection (SURVEY.md §2.2 row 1), with the page gather done by the DMA
-# engine per grid step instead of a materialized gather in HBM.
+# paged variants below keep the same flash math but OWN their data movement:
+# the pools stay in HBM (memory_space=ANY) and the kernel streams pages
+# through a two-slot VMEM buffer with explicit async copies — page c+1's
+# DMAs are issued BEFORE page c's flash update runs, so the fetch of the
+# next page overlaps the compute of the current one instead of serializing
+# behind it at a grid-step boundary.
+#
+# Why not the implicit grid pipeline (the pre-r6 implementation): with grid
+# (B, max_pages) every (slot, page) pair is its own grid step, and the r5
+# decomposition (PERF.md) measured ~14k such steps per fused substep, each
+# moving only ~0.5 MB — fixed per-step cost (DMA issue + kernel dispatch,
+# ~1 µs class) rivaled the stream time itself and pinned decode at ~36% of
+# the HBM roofline. Here the grid is (B/BB,): one step per BLOCK of BB
+# slots, the page loop lives inside the kernel (statically unrolled over the
+# table width), and each buffer fill issues BB page copies back-to-back —
+# BBx larger transfers in flight, BBx fewer grid steps, and dead pages
+# (beyond a block's longest slot, or below its sliding-window start) are
+# skipped outright rather than clamp-refetched. This is the TPU analogue of
+# vLLM's paged-attention block indirection (SURVEY.md §2.2 row 1) crossed
+# with the Ragged Paged Attention amortization argument (PAPERS.md): the
+# page gather is done by the DMA engine, overlapped, in block-sized batches.
+#
+# ``bblock`` (BB) is the knob the engine autotunes at startup
+# (Engine._resolve_decode_bblock: one-shot microbench over {1, 4, 8} per
+# (batch, page_size, kv_dtype)); 1 remains valid and still double-buffers.
 
 
-def _with_table(kernel):
-    """Adapt a (lengths, layer, ...) kernel to the paged scalar-prefetch
-    order (lengths, layer, table, ...): the flash bodies never read the table
-    — only the index maps do."""
-    def wrapped(lengths_ref, layer_ref, table_ref, *rest, **kw):
-        return kernel(lengths_ref, layer_ref, *rest, **kw)
-    return wrapped
+def _paged_db_body(lengths_ref, layer_ref, table_ref, q_ref, k_hbm, v_hbm,
+                   ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf,
+                   acc_ref, m_ref, l_ref, sem,
+                   *, ps: int, groups: int, scale: float, R: int, bb: int,
+                   num_pages: int, window: int, spec: bool):
+    """Shared double-buffered paged flash body (decode R=1 / spec-verify R>1,
+    bf16 / int8 pools, full / sliding-window attention).
+
+    One grid step handles BB slots end to end: init flash state, then walk
+    the block's live logical pages [lo, hi] with a two-slot VMEM buffer —
+    issue page c+1's copies, wait page c's, accumulate page c. The table is
+    scalar-prefetched (SMEM), so physical ids resolve in-kernel with no HBM
+    round trip. Per-slot raggedness inside a block rides the column mask
+    (shorter slots' dead columns contribute exp(-1e30 - m) == 0 exactly once
+    any live column has been seen — bit-identical to the skip-based
+    single-slot accumulation); the per-slot page index clamps into the
+    slot's OWN live range so a mixed block never fetches a neighbor's
+    garbage table entries.
+    """
+    g = pl.program_id(0)
+    lay = layer_ref[0]
+    quant = ks_hbm is not None
+    hq = q_ref.shape[1] // R
+    d = q_ref.shape[2]
+    hkv = k_buf.shape[2]
+    lens = jnp.stack([lengths_ref[g * bb + i] for i in range(bb)])   # [BB]
+    extent = lens + (R if spec else 0)
+    hi = jnp.maximum(pl.cdiv(extent, ps) - 1, 0)                     # [BB]
+    hi_max = jnp.max(hi)
+    if window > 0:
+        wstart = jnp.maximum(lens + (1 if spec else 0) - window, 0)
+        lo = wstart // ps                                            # [BB]
+        lo_min = jnp.min(lo)
+    else:
+        lo = jnp.zeros_like(lens)
+        lo_min = jnp.int32(0)
+
+    def live(c: int):
+        # block-level liveness of logical page c (c is a python int): some
+        # slot in the block still has rows there
+        return (c <= hi_max) & (c >= lo_min)
+
+    def copies(c: int, slot: int):
+        """The block's page-c DMAs into buffer ``slot`` (created identically
+        at start and wait time — the documented make_async_copy pattern)."""
+        out = []
+        for i in range(bb):
+            # clamp into slot i's own live range: table entries past it may
+            # be anything valid (scratch, stale) — never fetch them
+            pg = table_ref[g * bb + i, jnp.clip(c, lo[i], hi[i])]
+            out.append(pltpu.make_async_copy(
+                k_hbm.at[lay, pg], k_buf.at[slot, i], sem.at[slot, i, 0]))
+            out.append(pltpu.make_async_copy(
+                v_hbm.at[lay, pg], v_buf.at[slot, i], sem.at[slot, i, 1]))
+            if quant:
+                out.append(pltpu.make_async_copy(
+                    ks_hbm.at[lay, pg], ks_buf.at[slot, i],
+                    sem.at[slot, i, 2]))
+                out.append(pltpu.make_async_copy(
+                    vs_hbm.at[lay, pg], vs_buf.at[slot, i],
+                    sem.at[slot, i, 3]))
+        return out
+
+    def start(c: int):
+        @pl.when(live(c))
+        def _():
+            for dma in copies(c, c % 2):
+                dma.start()
+
+    def wait(c: int):
+        @pl.when(live(c))
+        def _():
+            for dma in copies(c, c % 2):
+                dma.wait()
+
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    start(0)                           # prologue: first page in flight
+    for c in range(num_pages):         # static unroll over the table width
+        if c + 1 < num_pages:
+            start(c + 1)               # fetch page c+1 while computing c
+        wait(c)
+
+        @pl.when(live(c))
+        def _accumulate(c=c):
+            buf = c % 2
+            k3 = k_buf[buf].astype(jnp.float32).reshape(bb * hkv, ps, d)
+            v3 = v_buf[buf].astype(jnp.float32).reshape(bb * hkv, ps, d)
+            if quant:
+                kscale = ks_buf[buf].reshape(bb * hkv, ps)
+                vscale = vs_buf[buf].reshape(bb * hkv, ps)
+            for r in range(R):         # static unroll over draft rows
+                sl = slice(r * hq, (r + 1) * hq)
+                q3 = (q_ref[:, sl].astype(jnp.float32) * scale) \
+                    .reshape(bb * hkv, groups, d)
+                s = jax.lax.dot_general(
+                    q3, k3, (((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)   # [BB*Hkv, G, ps]
+                if quant:
+                    s = s * kscale[:, None, :]
+                s = s.reshape(bb, hq, ps)
+                col = c * ps + jax.lax.broadcasted_iota(jnp.int32,
+                                                        (bb, hq, ps), 2)
+                limit = lens[:, None, None] + (1 + r if spec else 0)
+                live_col = col < limit
+                if window > 0:
+                    live_col &= col >= limit - window
+                s = jnp.where(live_col, s, NEG_INF)
+                m_prev = m_ref[:, sl, :1]
+                l_prev = l_ref[:, sl, :1]
+                m_cur = jnp.maximum(m_prev,
+                                    jnp.max(s, axis=-1, keepdims=True))
+                corr = jnp.exp(m_prev - m_cur)
+                p = jnp.exp(s - m_cur)
+                l_cur = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+                p3 = p.reshape(bb * hkv, groups, ps)
+                if quant:
+                    p3 = p3 * vscale[:, None, :]
+                pv = jax.lax.dot_general(
+                    p3, v3, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)   # [BB*Hkv, G, d]
+                acc_ref[:, sl] = acc_ref[:, sl] * corr \
+                    + pv.reshape(bb, hq, d)
+                m_ref[:, sl, :1] = m_cur
+                l_ref[:, sl, :1] = l_cur
+
+    l_fin = jnp.maximum(l_ref[:, :, :1], 1e-9)
+    o_ref[:] = (acc_ref[:] / l_fin).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "window"))
+def _paged_db_kernel(lengths_ref, layer_ref, table_ref, q_ref, k_hbm, v_hbm,
+                     o_ref, k_buf, v_buf, acc_ref, m_ref, l_ref, sem, **kw):
+    _paged_db_body(lengths_ref, layer_ref, table_ref, q_ref, k_hbm, v_hbm,
+                   None, None, o_ref, k_buf, v_buf, None, None,
+                   acc_ref, m_ref, l_ref, sem, **kw)
+
+
+def _paged_db_kernel_quant(lengths_ref, layer_ref, table_ref, q_ref, k_hbm,
+                           v_hbm, ks_hbm, vs_hbm, o_ref, k_buf, v_buf,
+                           ks_buf, vs_buf, acc_ref, m_ref, l_ref, sem, **kw):
+    _paged_db_body(lengths_ref, layer_ref, table_ref, q_ref, k_hbm, v_hbm,
+                   ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf,
+                   acc_ref, m_ref, l_ref, sem, **kw)
+
+
+def _resolve_bb(bblock, B: int) -> int:
+    """Largest divisor of B not exceeding the requested block (>= 1)."""
+    bb = max(1, min(int(bblock or 1), B))
+    while B % bb:
+        bb -= 1
+    return bb
+
+
+def _paged_flash_db(q2, pool_k, pool_v, lengths, layer_arr, table,
+                    *, bb: int, R: int, spec: bool, window: int,
+                    interpret: bool, pool_ks, pool_vs):
+    """Build + dispatch the double-buffered paged flash call.
+
+    q2: [B, R*Hq, D] (R=1 for plain decode). Grid is (B // bb,); the pools
+    ride as ANY-memory-space operands (never blocked by Pallas — the kernel
+    DMAs exactly the live pages), q/o are VMEM-blocked per slot block.
+    """
+    B, RHq, D = q2.shape
+    Hkv, ps = pool_k.shape[2], pool_k.shape[3]
+    groups = (RHq // R) // Hkv
+    num_pages = table.shape[1]
+    quant = pool_ks is not None
+
+    def q_map(g, lens, lay, tab):
+        return (g, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((bb, RHq, D), q_map),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    operands = [q2, pool_k, pool_v]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        operands += [pool_ks, pool_vs]
+    scratch = [
+        pltpu.VMEM((2, bb, Hkv, ps, D), pool_k.dtype),     # k page buffers
+        pltpu.VMEM((2, bb, Hkv, ps, D), pool_v.dtype),     # v page buffers
+    ]
+    if quant:
+        scratch += [pltpu.VMEM((2, bb, Hkv, ps), pool_ks.dtype)] * 2
+    scratch += [
+        pltpu.VMEM((bb, RHq, D), jnp.float32),             # acc
+        pltpu.VMEM((bb, RHq, 128), jnp.float32),           # m
+        pltpu.VMEM((bb, RHq, 128), jnp.float32),           # l
+        pltpu.SemaphoreType.DMA((2, bb, 4 if quant else 2)),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B // bb,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bb, RHq, D), q_map),
+        scratch_shapes=scratch,
+    )
+    kernel = functools.partial(
+        _paged_db_kernel_quant if quant else _paged_db_kernel,
+        ps=ps, groups=groups, scale=1.0 / (D ** 0.5), R=R, bb=bb,
+        num_pages=num_pages, window=window, spec=spec)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, RHq, D), q2.dtype),
+        interpret=interpret,
+    )(lengths, layer_arr, table, *operands)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "window", "bblock"))
 def decode_attend_pallas_paged(q: jnp.ndarray, pool_k: jnp.ndarray,
                                pool_v: jnp.ndarray, lengths: jnp.ndarray,
                                layer: jnp.ndarray, table: jnp.ndarray,
                                interpret: bool = False,
                                pool_ks: jnp.ndarray = None,
                                pool_vs: jnp.ndarray = None,
-                               window: int = 0):
-    """Flash decode attention over one layer of the PAGED pool.
+                               window: int = 0,
+                               bblock: int = 1):
+    """Double-buffered flash decode attention over one layer of the PAGED
+    pool.
 
     q: [B, 1, Hq, D]; pool_k/v: [L, P, Hkv, page, D]; lengths: [B] (counting
     the just-written token); layer: scalar int32; table: [B, max_pages] int32
     physical page ids (row b maps slot b's logical pages; entries at or past
     the slot's live range may be any valid id — they are clamped away, never
     fetched). Returns [B, 1, Hq, D]. pool_ks/vs switch the int8 scale-folding
-    body, as in the dense kernel.
+    body, as in the dense kernel. ``bblock`` slots share each grid step
+    (resolved to the largest divisor of B); page i+1 prefetches while page i
+    computes regardless of bblock — see _paged_db_body.
     """
-    B, _, Hq, D = q.shape
-    Hkv, ps = pool_k.shape[2], pool_k.shape[3]
-    groups = Hq // Hkv
-    quant = pool_ks is not None
-    max_pages = table.shape[1]
+    B = q.shape[0]
     lengths = lengths.astype(jnp.int32)
     layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
-    table = table.astype(jnp.int32)
-
-    def q_map(b, c, lens, lay, tab):
-        return (b, 0, 0)
-
-    def _phys(b, c, lens, tab):
-        hi = jnp.maximum(pl.cdiv(lens[b], ps) - 1, 0)
-        if window > 0:
-            lo_page = jnp.maximum(lens[b] - window, 0) // ps
-            c = jnp.clip(c, lo_page, hi)
-        else:
-            c = jnp.minimum(c, hi)
-        return tab[b, c]
-
-    def kv_map(b, c, lens, lay, tab):
-        return (lay[0], _phys(b, c, lens, tab), 0, 0, 0)
-
-    def scale_map(b, c, lens, lay, tab):
-        return (lay[0], _phys(b, c, lens, tab), 0, 0)
-
-    in_specs = [
-        pl.BlockSpec((1, Hq, D), q_map),
-        pl.BlockSpec((1, 1, Hkv, ps, D), kv_map),
-        pl.BlockSpec((1, 1, Hkv, ps, D), kv_map),
-    ]
-    operands = [q[:, 0], pool_k, pool_v]
-    if quant:
-        # scale block spans the FULL page axis (the array's lane axis), which
-        # Mosaic always allows — no 128-multiple constraint on page_size
-        in_specs += [pl.BlockSpec((1, 1, Hkv, ps), scale_map)] * 2
-        operands += [pool_ks, pool_vs]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(B, max_pages),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, Hq, D), q_map),
-        scratch_shapes=[
-            pltpu.VMEM((Hq, D), jnp.float32),
-            pltpu.VMEM((Hq, 128), jnp.float32),
-            pltpu.VMEM((Hq, 128), jnp.float32),
-        ],
-    )
-    kernel = _with_table(functools.partial(
-        _decode_kernel_layer_q if quant else _decode_kernel_layer,
-        chunk=ps, groups=groups, scale=1.0 / (D ** 0.5), window=window))
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
-        interpret=interpret,
-    )(lengths, layer_arr, table, *operands)
+    out = _paged_flash_db(
+        q[:, 0], pool_k, pool_v, lengths, layer_arr, table.astype(jnp.int32),
+        bb=_resolve_bb(bblock, B), R=1, spec=False, window=window,
+        interpret=interpret, pool_ks=pool_ks, pool_vs=pool_vs)
     return out[:, None]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "window"))
+@functools.partial(jax.jit, static_argnames=("interpret", "window", "bblock"))
 def decode_attend_pallas_spec_paged(q: jnp.ndarray, pool_k: jnp.ndarray,
                                     pool_v: jnp.ndarray, lengths: jnp.ndarray,
                                     layer: jnp.ndarray, table: jnp.ndarray,
                                     interpret: bool = False,
                                     pool_ks: jnp.ndarray = None,
                                     pool_vs: jnp.ndarray = None,
-                                    window: int = 0) -> jnp.ndarray:
-    """Paged speculative-verify attention: R query rows per slot, one pass.
+                                    window: int = 0,
+                                    bblock: int = 1) -> jnp.ndarray:
+    """Paged speculative-verify attention: R query rows per slot, one pass,
+    double-buffered page streaming (see _paged_db_body).
 
     q: [B, R, Hq, D]; row r masks to columns < lengths + 1 + r. The caller
     has already written all R rows (their pages allocated up front — the
     engine's ensure-pages step covers lengths + R). Same economics as the
-    dense spec kernel: one page stream serves all R queries.
+    dense spec kernel: one page stream serves all R queries — and with
+    ``bblock`` > 1, all BB slots of a block.
     """
     B, R, Hq, D = q.shape
-    Hkv, ps = pool_k.shape[2], pool_k.shape[3]
-    groups = Hq // Hkv
-    quant = pool_ks is not None
-    max_pages = table.shape[1]
     lengths = lengths.astype(jnp.int32)
     layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
-    table = table.astype(jnp.int32)
-
-    def q_map(b, c, lens, lay, tab):
-        return (b, 0, 0)
-
-    def _phys(b, c, lens, tab):
-        hi = jnp.maximum(pl.cdiv(lens[b] + R, ps) - 1, 0)
-        if window > 0:
-            lo_page = jnp.maximum(lens[b] + 1 - window, 0) // ps
-            c = jnp.clip(c, lo_page, hi)
-        else:
-            c = jnp.minimum(c, hi)
-        return tab[b, c]
-
-    def kv_map(b, c, lens, lay, tab):
-        return (lay[0], _phys(b, c, lens, tab), 0, 0, 0)
-
-    def scale_map(b, c, lens, lay, tab):
-        return (lay[0], _phys(b, c, lens, tab), 0, 0)
-
-    in_specs = [
-        pl.BlockSpec((1, R * Hq, D), q_map),
-        pl.BlockSpec((1, 1, Hkv, ps, D), kv_map),
-        pl.BlockSpec((1, 1, Hkv, ps, D), kv_map),
-    ]
-    operands = [q.reshape(B, R * Hq, D), pool_k, pool_v]
-    if quant:
-        in_specs += [pl.BlockSpec((1, 1, Hkv, ps), scale_map)] * 2
-        operands += [pool_ks, pool_vs]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(B, max_pages),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, R * Hq, D), q_map),
-        scratch_shapes=[
-            pltpu.VMEM((R * Hq, D), jnp.float32),
-            pltpu.VMEM((R * Hq, 128), jnp.float32),
-            pltpu.VMEM((R * Hq, 128), jnp.float32),
-        ],
-    )
-    kernel = _with_table(functools.partial(
-        _spec_kernel_quant if quant else _spec_kernel_plain,
-        chunk=ps, groups=groups, scale=1.0 / (D ** 0.5), R=R, window=window))
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, R * Hq, D), q.dtype),
-        interpret=interpret,
-    )(lengths, layer_arr, table, *operands)
+    out = _paged_flash_db(
+        q.reshape(B, R * Hq, D), pool_k, pool_v, lengths, layer_arr,
+        table.astype(jnp.int32), bb=_resolve_bb(bblock, B), R=R, spec=True,
+        window=window, interpret=interpret, pool_ks=pool_ks, pool_vs=pool_vs)
     return out.reshape(B, R, Hq, D)
 
 

@@ -101,7 +101,23 @@ def sample(
     scaled = vals / safe_t
     if jnp.ndim(rng) == 1 and jax.dtypes.issubdtype(rng.dtype,
                                                     jax.dtypes.prng_key):
-        draw = jax.vmap(jax.random.categorical)(rng, scaled)    # per-slot
+        # Seeded path: TOKEN-ID-KEYED Gumbel-max over the candidate set. The
+        # noise for token t is a pure function of (slot key, t), so masking
+        # one token (min_tokens stop suppression, logit_bias -100, grammar
+        # bans) never perturbs any other token's draw — a banned stream
+        # diverges from its unbanned twin only at positions where the banned
+        # token would have WON. jax.random.categorical's slot-positional
+        # gumbel lacks this: one masked token shifts every later candidate
+        # into a different slot and reshuffles the whole draw (the
+        # engine-level min_tokens determinism contract in test_engine).
+        # Cost: MAX_TOPK fold_in+uniform per slot — noise next to the
+        # forward pass.
+        def slot_draw(key, row_scaled, row_ids):
+            u = jax.vmap(lambda t: jax.random.uniform(
+                jax.random.fold_in(key, t), minval=1e-20))(row_ids)
+            return jnp.argmax(row_scaled - jnp.log(-jnp.log(u)))
+
+        draw = jax.vmap(slot_draw)(rng, scaled, idxs)           # per-slot
     else:
         draw = jax.random.categorical(rng, scaled, axis=-1)     # [B] in [0,K)
     sampled = jnp.take_along_axis(idxs, draw[:, None], axis=1)[:, 0].astype(jnp.int32)

@@ -115,7 +115,10 @@ def make_pipeline_lm_loss(cfg: ModelConfig, mesh: Mesh, n_microbatches: int,
     def shard_body(params, tokens, loss_mask):
         # tokens: [M, mb, T] (this dp shard's microbatches)
         pp_idx = jax.lax.axis_index("pp")
-        PP = jax.lax.axis_size("pp")
+        # static stage count from the mesh (jax.lax.axis_size only exists on
+        # newer jax than the pinned 0.4.x image; PP feeds range()/arange(), so
+        # it must be a Python int anyway)
+        PP = int(mesh.shape["pp"])
         p_stage = jax.tree.map(lambda x: x[0], params["layers"])  # [Lpp, ...]
         _, mb, T = tokens.shape
         H = cfg.hidden_size

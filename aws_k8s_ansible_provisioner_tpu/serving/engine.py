@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import os
 import queue
 import threading
 import time
@@ -185,6 +186,39 @@ BAN_K = 8
 # map at 300 entries; vLLM-grade clients rarely exceed a few dozen — the
 # server rejects beyond this). Padding ids are out-of-vocab and DROP.
 BIAS_K = 64
+
+# Candidate batch-block sizes for the double-buffered paged decode kernel
+# (ops/pallas_attention._paged_db_body): BB slots share one grid step, so
+# each step issues BBx larger page DMAs and the per-substep grid-step count
+# divides by BB. The best BB depends on (batch, page_size, kv_dtype) — the
+# engine microbenches these at startup (PALLAS_DECODE_BBLOCK's off-by-default
+# env gate, promoted to a first-class autotuned parameter in r6).
+BBLOCK_CANDIDATES = (1, 4, 8)
+# (batch, page_size, kv_dtype) -> chosen bb. Module-level so a second engine
+# start in the same process (replica respawn, tests, bench retries) reuses
+# the choice instead of re-running the microbench.
+_BBLOCK_CACHE: dict = {}
+
+
+def pick_decode_bblock(candidates, bench_once, timer=time.perf_counter,
+                       reps: int = 3) -> int:
+    """Deterministic selection: for each candidate (ascending), one untimed
+    warmup call (compile + cache fill), then ``reps`` timed calls; the
+    candidate with the lowest MEDIAN wins, ties going to the SMALLER block
+    (strict < — so a fixed timer sequence always yields the same choice,
+    and noise can only flip a decision across a real gap, not a tie)."""
+    best_bb, best_t = None, None
+    for bb in candidates:
+        bench_once(bb)                      # warmup: compile outside timing
+        times = []
+        for _ in range(max(1, reps)):
+            t0 = timer()
+            bench_once(bb)
+            times.append(timer() - t0)
+        med = sorted(times)[len(times) // 2]
+        if best_t is None or med < best_t:
+            best_bb, best_t = bb, med
+    return best_bb
 
 
 def _apply_logit_bias(logits: jnp.ndarray, bias_ids, bias_vals) -> jnp.ndarray:
@@ -495,7 +529,8 @@ def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
 
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "impl",
                                                           "logprobs",
-                                                          "penalties"),
+                                                          "penalties",
+                                                          "bblock"),
          donate_argnums=(3,), donate_argnames=("counts",))
 def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
                  lengths, rng, temperature, top_k, top_p, mesh=None,
@@ -504,7 +539,8 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
                  repetition=None, prompt_mask=None,
                  penalties: bool = False, table=None, seeds=None,
                  ban_ids=None, ban_until=None, bias_ids=None,
-                 bias_vals=None, allow=None, lora_idx=None):
+                 bias_vals=None, allow=None, lora_idx=None,
+                 bblock: int = 1):
     """``n_steps`` fused decode steps for every slot, one device dispatch.
 
     tokens/lengths/sampling params: [B]. Returns (cache, out [n_steps, B]).
@@ -530,10 +566,12 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
         # is the paged pool and the kernels address pages through it.
         if table is not None:
             attend = make_decode_attend_carry_paged(
-                lens, table, impl=impl, mesh=mesh, window=cfg.sliding_window)
+                lens, table, impl=impl, mesh=mesh, window=cfg.sliding_window,
+                bblock=bblock)
         else:
             attend = make_decode_attend_carry(lens, impl=impl, mesh=mesh,
-                                              window=cfg.sliding_window)
+                                              window=cfg.sliding_window,
+                                              bblock=bblock)
         logits, cache = model_forward_carry(params, cfg, tok[:, None],
                                             positions, cache, attend)
         step_logits = logits[:, 0, :]
@@ -580,12 +618,13 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
     return cache, counts, out
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("impl", "mesh"),
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("impl", "mesh",
+                                                          "bblock"),
          donate_argnums=(3,))
 def spec_decode_step(cfg: ModelConfig, R: int, params, cache, tokens,
                      lengths, rng, temperature, top_k, top_p,
                      impl: str = "auto", table=None, seeds=None, mesh=None,
-                     lora_idx=None):
+                     lora_idx=None, bblock: int = 1):
     """Speculative verify: R tokens per slot in ONE dispatch.
 
     tokens: [B, R] = [last accepted token, spec_k prompt-lookup drafts];
@@ -607,7 +646,8 @@ def spec_decode_step(cfg: ModelConfig, R: int, params, cache, tokens,
     if table is not None:
         attend = make_spec_attend_carry_paged(lengths, table, impl=impl,
                                               mesh=mesh,
-                                              window=cfg.sliding_window)
+                                              window=cfg.sliding_window,
+                                              bblock=bblock)
     else:
         attend = make_spec_attend_carry(lengths, impl=impl, mesh=mesh,
                                         window=cfg.sliding_window)
@@ -675,9 +715,12 @@ class Engine:
         self.buckets = tuple(b for b in serving.prefill_buckets
                              if b <= self.max_len)
         dtype = jnp.bfloat16 if serving.dtype == "bfloat16" else jnp.float32
-        if serving.weights_dtype not in ("auto", "int8"):
+        if serving.weights_dtype not in ("auto", "bf16", "int8"):
+            # "int8" is the SHIPPED default (PERF.md: the weight stream is the
+            # dominant bytes/token term at small batch); "bf16" (alias
+            # "auto") is the explicit opt-out that keeps the load dtype.
             raise ValueError(f"weights_dtype={serving.weights_dtype!r}: "
-                             f"expected 'auto' or 'int8'")
+                             f"expected 'int8' (default), 'bf16', or 'auto'")
         if serving.weights_dtype == "int8":
             # Weights-only int8 (models/quant.py): quantized on host/device
             # BEFORE the mesh sharding below, so each chip receives the
@@ -1001,6 +1044,82 @@ class Engine:
         # only ever written at/past a slot's current length, so a freed
         # slot's prompt rows stay intact until the slot is reused).
         self._slot_tokens: List[tuple] = [()] * self.num_slots
+        # Batch-block size for the decode kernels (PALLAS_DECODE_BBLOCK
+        # promoted to a first-class parameter): explicit config/env override,
+        # else a one-shot deterministic startup microbench over
+        # BBLOCK_CANDIDATES per (batch, page_size, kv_dtype) — TPU-only (the
+        # guard keeps CPU tests and the tier-1 gate free of it). Reported on
+        # /healthz and as the tpu_serve_decode_bblock gauge.
+        self.decode_bblock = self._resolve_decode_bblock()
+        self.metrics.decode_bblock.set(self.decode_bblock)
+
+    # -- decode batch-block autotune ----------------------------------------
+
+    # injectable for the deterministic-selection tests (fake timer)
+    _bblock_timer = staticmethod(time.perf_counter)
+
+    def _fit_bblock(self, req: int) -> int:
+        """Largest divisor of the slot count not exceeding the request."""
+        bb = max(1, min(int(req), self.num_slots))
+        while self.num_slots % bb:
+            bb -= 1
+        return bb
+
+    def _bblock_autotune_supported(self) -> bool:
+        """The microbench dispatches the real paged kernel, so it needs the
+        paged single-device TPU path: never under JAX_PLATFORMS=cpu (tier-1
+        must stay fast — interpret-mode timing is meaningless anyway) and
+        never under a mesh (the pool is sharded; the direct kernel call
+        below is unsharded — meshes keep bb=1 until tuned explicitly)."""
+        return (self.paged and self.mesh is None
+                and jax.default_backend() == "tpu")
+
+    def _bblock_bench_once(self, bb: int) -> None:
+        """One steady-state decode-attention dispatch at block size ``bb``:
+        full-window lengths (every page live — the worst-case stream the
+        served config must sustain) over a synthetic table cycling the
+        pool's real pages. Blocks until the result is ready so the timer
+        wraps device time, not dispatch issue."""
+        from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
+
+        cfg = self.cfg
+        ps = self.serving.page_size
+        q = jnp.zeros((self.num_slots, 1, cfg.num_heads, cfg.head_dim),
+                      jnp.bfloat16 if self.serving.dtype == "bfloat16"
+                      else jnp.float32)
+        lengths = jnp.full((self.num_slots,), self.pages_per_slot * ps,
+                           jnp.int32)
+        total = self.cache["k"].shape[1]
+        tab = (np.arange(self.num_slots * self.pages_per_slot,
+                         dtype=np.int32).reshape(self.num_slots,
+                                                 self.pages_per_slot)
+               % max(1, total - 1)) + 1          # skip the scratch page
+        kw = {}
+        if self.kv_quant:
+            kw = dict(pool_ks=self.cache["ks"], pool_vs=self.cache["vs"])
+        out = pallas_attention.decode_attend_pallas_paged(
+            q, self.cache["k"], self.cache["v"], lengths, jnp.int32(0),
+            jnp.asarray(tab), bblock=bb, window=self.cfg.sliding_window,
+            **kw)
+        jax.block_until_ready(out)
+
+    def _resolve_decode_bblock(self) -> int:
+        env = os.environ.get("PALLAS_DECODE_BBLOCK", "")
+        req = int(env) if env.strip() else int(self.serving.decode_bblock)
+        if req > 0:
+            return self._fit_bblock(req)     # explicit pin wins, no bench
+        key = (self.num_slots, self.serving.page_size,
+               "int8" if self.kv_quant else "bf16")
+        if key in _BBLOCK_CACHE:
+            return self._fit_bblock(_BBLOCK_CACHE[key])
+        if not self._bblock_autotune_supported():
+            return 1
+        cands = [b for b in BBLOCK_CANDIDATES
+                 if b <= self.num_slots and self.num_slots % b == 0]
+        choice = pick_decode_bblock(cands or [1], self._bblock_bench_once,
+                                    timer=self._bblock_timer)
+        _BBLOCK_CACHE[key] = choice
+        return choice
 
     @staticmethod
     def _build_mesh(serving: ServingConfig):
@@ -2078,7 +2197,8 @@ class Engine:
             jnp.asarray(self.top_ps), impl=self.serving.attention_impl,
             table=jnp.asarray(self.table) if self.paged else None,
             seeds=jnp.asarray(self.seeds), mesh=self.mesh,
-            lora_idx=self._lora_vec())
+            lora_idx=self._lora_vec(),
+            bblock=self.decode_bblock)
         out = np.asarray(out)
         accepted = np.asarray(accepted)
         dt = time.monotonic() - t0
@@ -2229,7 +2349,8 @@ class Engine:
             bias_ids=jnp.asarray(self.bias_ids),
             bias_vals=jnp.asarray(self.bias_vals),
             allow=self._allow_words(gslots),
-            lora_idx=self._lora_vec())
+            lora_idx=self._lora_vec(),
+            bblock=self.decode_bblock)
         # un-penalized dispatches return a dummy counts array — keep ours
         self.counts = new_counts if want_pen else real_counts
         lp_t = None
@@ -2481,7 +2602,8 @@ class Engine:
                     ban_until=jnp.asarray(self.ban_until),
                     bias_ids=jnp.asarray(self.bias_ids),
                     bias_vals=jnp.asarray(self.bias_vals),
-                    lora_idx=self._lora_vec())
+                    lora_idx=self._lora_vec(),
+                    bblock=self.decode_bblock)
             return
 
         # Distinct token values per warmup request — identical prompts would
@@ -2570,7 +2692,8 @@ class Engine:
             ban_until=jnp.asarray(self.ban_until),
             bias_ids=jnp.asarray(self.bias_ids),
             bias_vals=jnp.asarray(self.bias_vals),
-                    lora_idx=self._lora_vec())
+                    lora_idx=self._lora_vec(),
+                    bblock=self.decode_bblock)
         del cnts, mask
         # Logprobs program variants ('logprobs' is a static arg on every step
         # fn — distinct programs): one isolated request compiles the
@@ -2608,4 +2731,5 @@ class Engine:
             ban_until=jnp.asarray(self.ban_until),
             bias_ids=jnp.asarray(self.bias_ids),
             bias_vals=jnp.asarray(self.bias_vals),
-                    lora_idx=self._lora_vec())
+                    lora_idx=self._lora_vec(),
+                    bblock=self.decode_bblock)

@@ -189,6 +189,13 @@ class EngineMetrics:
         self.kv_pages_in_use = r.register(Gauge(
             "tpu_serve_kv_pages_in_use",
             "KV pages currently referenced by live requests"))
+        # Batch-block size the decode kernels run with (autotuned at engine
+        # start per (batch, page_size, kv_dtype) — see
+        # Engine._resolve_decode_bblock). A dashboard seeing 1 on a TPU pod
+        # means the autotuner was pinned or guarded off.
+        self.decode_bblock = r.register(Gauge(
+            "tpu_serve_decode_bblock",
+            "Decode kernel batch-block size (slots per grid step)"))
 
     def mark_request(self, status: str, duration_s: float):
         self.request_total.inc(status=status)

@@ -201,6 +201,12 @@ class Handler(BaseHTTPRequestHandler):
                 "queue_depth": len(eng.pending),
                 "stalled_for_s": round(stalled, 1) or None,
                 "last_error": eng.last_error or None,
+                # the autotuned decode batch-block (ISSUE r6): operators can
+                # confirm the served kernel config without scraping metrics
+                "decode_bblock": getattr(eng, "decode_bblock", None),
+                "weights_dtype": eng.serving.weights_dtype,
+                "kv_dtype": eng.serving.kv_dtype,
+                "paged": bool(getattr(eng, "paged", False)),
             })
         elif path == "/load":
             # Tiny load snapshot for the gateway's ~1 Hz poller (router.py
@@ -1018,11 +1024,17 @@ def main(argv=None):
     p.add_argument("--kv-dtype", default="auto", choices=["auto", "int8"],
                    help="KV-cache storage dtype; int8 halves cache HBM "
                         "footprint/bandwidth (~2x the decode slots per chip)")
-    p.add_argument("--weights-dtype", default="auto",
-                   choices=["auto", "int8"],
-                   help="weight storage dtype; int8 halves the weight HBM "
-                        "stream (weights-only per-channel quantization; "
-                        "compute stays bf16 on the MXU)")
+    p.add_argument("--weights-dtype", default="int8",
+                   choices=["int8", "bf16", "auto"],
+                   help="weight storage dtype; int8 (the shipped default) "
+                        "halves the weight HBM stream — the dominant "
+                        "bytes/token term at small batch (weights-only "
+                        "per-channel quantization; compute stays bf16 on "
+                        "the MXU). 'bf16' (alias 'auto') is the explicit "
+                        "full-precision opt-out")
+    p.add_argument("--decode-bblock", type=int, default=0,
+                   help="decode kernel batch-block (slots per grid step); "
+                        "0 = autotune over {1,4,8} at startup (TPU only)")
     p.add_argument("--chat-template", default="",
                    help="path to a Jinja chat template file")
     p.add_argument("--platform", default="",
@@ -1099,6 +1111,7 @@ def main(argv=None):
         max_decode_slots=args.max_decode_slots,
         max_cache_len=args.max_cache_len, dtype=args.dtype,
         kv_dtype=args.kv_dtype, weights_dtype=args.weights_dtype,
+        decode_bblock=args.decode_bblock,
         checkpoint_dir=args.checkpoint_dir, chat_template=args.chat_template,
         prefill_chunk=args.prefill_chunk,
         prefix_cache=not args.no_prefix_cache,

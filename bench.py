@@ -88,6 +88,51 @@ HBM_BYTES_PER_S = {"v4": 1.2e12, "v5e": 8.19e11, "v5p": 2.77e12,
                    "v6e": 1.6e12}
 
 
+def _last_tpu_artifact() -> dict | None:
+    """Newest banked on-chip bench artifact (BENCH_*.json with
+    platform=="tpu" and a real value), summarized for embedding in a
+    fallback result. A dead tunnel's CPU number then carries the last REAL
+    TPU headline (value, git rev, age) alongside it, so a 1.99 tok/s
+    liveness proof can never read as the round's measurement again
+    (VERDICT r5 next #4).
+    """
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None   # (mtime, record, path)
+    for path in glob.glob(os.path.join(here, "BENCH_*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if rec.get("platform") != "tpu" or not rec.get("value"):
+            continue
+        mtime = os.path.getmtime(path)
+        if best is None or mtime > best[0]:
+            best = (mtime, rec, path)
+    if best is None:
+        return None
+    mtime, rec, path = best
+    rev = None
+    try:
+        p = subprocess.run(["git", "log", "-1", "--format=%h", "--",
+                            os.path.basename(path)],
+                           capture_output=True, text=True, cwd=here,
+                           timeout=10)
+        rev = p.stdout.strip() or None
+    except Exception:
+        pass
+    return {
+        "value": rec.get("value"),
+        "unit": rec.get("unit"),
+        "metric": rec.get("metric"),
+        "file": os.path.basename(path),
+        "git_rev": rev,
+        "age_days": round((time.time() - mtime) / 86400.0, 1),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Parent: subprocess orchestration (no jax imported here)
 # ---------------------------------------------------------------------------
@@ -211,6 +256,10 @@ def main() -> None:
     def finish(result: dict) -> None:
         result["tunnel_probes"] = probes
         result["tpu_unavailable"] = not any(p["ok"] for p in probes)
+        if result["tpu_unavailable"]:
+            # a dead tunnel must never publish a CPU number as the round's
+            # headline: carry the newest banked on-chip artifact beside it
+            result["last_tpu"] = _last_tpu_artifact()
         if errors:
             if result.get("platform") == "tpu":
                 # a successful TPU number after failed attempts: record the
@@ -387,7 +436,9 @@ def measure() -> None:
 
     import jax.numpy as jnp
 
-    from aws_k8s_ansible_provisioner_tpu.config import QWEN3_0_6B, ServingConfig
+    from aws_k8s_ansible_provisioner_tpu.config import (QWEN3_0_6B,
+                                                        ServingConfig,
+                                                        tiny_qwen3)
     from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
     from aws_k8s_ansible_provisioner_tpu.ops.attention import resolve_impl
     from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
@@ -396,10 +447,15 @@ def measure() -> None:
     on_tpu = platform == "tpu"
     impl = resolve_impl("auto")
 
-    cfg = QWEN3_0_6B
     # TPU_BENCH_* env overrides let the tuning sweep reuse this exact
     # measurement path; the defaults ARE the tuned config.
     env = os.environ.get
+    # --dry (TPU_BENCH_DRY=1): a seconds-class CPU pass over the tiny model
+    # that exercises the identical config/field plumbing — every JSON field
+    # of a real run (bblock, weights_dtype, dma_steps_per_substep, roofline
+    # names) exists here too, so field regressions surface without a chip.
+    dry = bool(int(env("TPU_BENCH_DRY", "0")))
+    cfg = tiny_qwen3() if dry else QWEN3_0_6B
     # The batch default is COUPLED to the cache dtype: bf16 at batch 128
     # doesn't fit (15 GB cache + 1.2 GB weights > 16 GB HBM), so a bf16
     # sweep run inherits the bf16-feasible batch unless it overrides both.
@@ -422,22 +478,36 @@ def measure() -> None:
         # TTFT p50 860 -> 554 ms vs 16/dispatch at identical throughput.
         max_prefill_batch=int(env("TPU_BENCH_PREFILL_BATCH",
                                   32 if on_tpu else 4)),
+        # TTFT lever #2 (VERDICT r5 weak #3): chunked prefill interleaves
+        # decode between chunks — bench_sweep --ttft drives this axis to
+        # turn the one bad cold-burst TTFT into a measured curve.
+        prefill_chunk=int(env("TPU_BENCH_PREFILL_CHUNK", "0")),
         kv_dtype=kv_dtype,
-        # Weights-only int8 A/B (VERDICT r3 next #7): halves the dominant
-        # weight-stream term of bytes/token — the roofline ceiling moves
-        # automatically (weights_bytes reads the quantized tree).
-        weights_dtype=env("TPU_BENCH_WEIGHTS", "auto"),
+        # int8 weights are the SHIPPED default (ServingConfig.weights_dtype;
+        # r6): halves the dominant weight-stream term of bytes/token — the
+        # roofline ceiling moves automatically (weights_bytes reads the
+        # quantized tree). TPU_BENCH_WEIGHTS=bf16 is the A/B opt-out.
+        weights_dtype=env("TPU_BENCH_WEIGHTS",
+                          ServingConfig.weights_dtype),
         # Default matches ServingConfig.paged=True so the headline number
         # measures the path production actually executes (ADVICE r3). The
         # parent's retry attempt A/Bs TPU_BENCH_PAGED=0 so a paged-specific
         # Mosaic lowering failure can't zero the round's one measurement.
         paged=bool(int(env("TPU_BENCH_PAGED", "1"))),
-        # Paged DMA granularity: the paged decode kernel streams one page
-        # per grid step, so page_size is its chunk size — larger pages
-        # amortize grid-step overhead at the cost of coarser admission.
-        page_size=int(env("TPU_BENCH_PAGE_SIZE", "64")),
+        # Paged DMA granularity: the double-buffered paged decode kernel
+        # streams one page per buffer fill, so page_size is its chunk size —
+        # larger pages amortize DMA-issue overhead at the cost of coarser
+        # admission.
+        page_size=int(env("TPU_BENCH_PAGE_SIZE", "32" if dry else "64")),
+        # Decode batch-block: 0 = the engine's startup autotune over
+        # {1, 4, 8} (TPU only; exactly what a production pod runs), a
+        # positive value pins it for the sweep's bblock axis.
+        decode_bblock=int(env("TPU_BENCH_BBLOCK", "0")),
+        # the tiny dry model runs f32 on CPU (parity with the test substrate)
+        dtype="float32" if dry else "bfloat16",
     )
-    params = init_params(cfg, jax.random.PRNGKey(0), jnp.bfloat16)
+    params = init_params(cfg, jax.random.PRNGKey(0),
+                         jnp.float32 if dry else jnp.bfloat16)
     engine = Engine(cfg, params, serving)
     # Bench-scope warmup: ONLY the batched-prefill and fused-decode programs
     # the measured path dispatches (2 compiles, not ~10 — the r2 timeout was
@@ -450,7 +520,8 @@ def measure() -> None:
     reqs = []
     for i in range(n_slots):
         reqs.append(engine.submit(
-            Request(prompt_ids=[(7 * i + 3) % 1000 + 10] * 16,
+            Request(prompt_ids=[(7 * i + 3) % min(1000, cfg.vocab_size - 20)
+                                + 10] * 16,
                     max_tokens=gen_budget, ignore_eos=True)))
     while engine.pending:
         engine.step()
@@ -487,8 +558,19 @@ def measure() -> None:
         mean_ctx = float(sum(engine.lengths[:n_slots]) / n_slots)
         roof = _roofline(engine.params, cfg, serving, mean_ctx, n_slots) \
             if on_tpu else {}
+        # The decomposition this round's kernel work changes (ISSUE r6):
+        # per fused decode substep, the decode-attention stream issues one
+        # buffer fill per (layer, slot-block, live page/chunk). bb divides
+        # the block count; double-buffering overlaps — but does not remove —
+        # each fill. ~14k at the r5 config (bb=1); /bb thereafter.
+        bb = max(1, int(getattr(engine, "decode_bblock", 1)))
+        stream_chunk = serving.page_size if serving.paged else 256
+        dma_steps = (cfg.num_layers
+                     * -(-n_slots // bb)
+                     * max(1, -(-int(max(1.0, mean_ctx)) // stream_chunk)))
+        model_tag = "tiny-qwen3 DRY" if dry else "qwen3-0.6b"
         out = {
-            "metric": f"qwen3-0.6b decode tokens/sec/chip "
+            "metric": f"{model_tag} decode tokens/sec/chip "
                       f"(batch={n_slots}, {platform})",
             "value": round(tps, 2),
             "unit": "tokens/sec",
@@ -498,12 +580,23 @@ def measure() -> None:
             "kv_dtype": serving.kv_dtype,
             "weights_dtype": serving.weights_dtype,
             "paged": serving.paged,
+            "bblock": bb,
+            "dma_steps_per_substep": int(dma_steps),
+            "prefill_batch": serving.max_prefill_batch,
+            "prefill_chunk": serving.prefill_chunk,
             "ttft_p50_ms": round(ttft_p50_ms, 2),
             "batch": n_slots,
             "decode_horizon": horizon,
             **extra,
             **roof,
         }
+        if dry:
+            # --dry is a field-plumbing proof, never a perf claim: label it
+            # and carry the newest banked TPU artifact like any other
+            # no-chip result
+            out["dry"] = True
+            out["tpu_unavailable"] = True
+            out["last_tpu"] = _last_tpu_artifact()
         if roof:
             out["pct_of_ceiling"] = round(100 * tps / roof["ceiling_toks_per_s"], 1)
             if "device_only_toks_per_s" in out:
@@ -574,6 +667,14 @@ def measure() -> None:
 
 if __name__ == "__main__":
     if "--measure" in sys.argv:
+        measure()
+    elif "--dry" in sys.argv:
+        # Seconds-class CPU pass over the tiny model, in-process: proves the
+        # whole field plumbing (bblock, weights_dtype, dma_steps_per_substep,
+        # last_tpu) without a chip and without the probe/retry machinery.
+        os.environ["TPU_BENCH_PLATFORM"] = "cpu"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["TPU_BENCH_DRY"] = "1"
         measure()
     else:
         main()

@@ -1,0 +1,162 @@
+"""Decode batch-block autotuner tests (Engine._resolve_decode_bblock).
+
+The autotuner is a ONE-SHOT startup microbench over BBLOCK_CANDIDATES,
+deterministic by construction (fixed reps, median, strict-< tie-break) and
+guarded off the CPU test substrate — these tests pin all three properties
+with a fake timer and a counting fake microbench, never a real dispatch.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+from aws_k8s_ansible_provisioner_tpu.serving import engine as eng_mod
+from aws_k8s_ansible_provisioner_tpu.serving.engine import (
+    Engine, pick_decode_bblock)
+
+
+def _mk_engine(monkeypatch=None, page_size=8, slots=8, **srv):
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    serving = ServingConfig(model="tiny-qwen3", max_decode_slots=slots,
+                            max_cache_len=64, page_size=page_size,
+                            dtype="float32", weights_dtype="bf16",
+                            prefill_buckets=(16,), **srv)
+    return Engine(cfg, params, serving)
+
+
+class _FakeTimer:
+    """Scripted perf_counter: consumes (t0, t1) pairs so each timed rep sees
+    a chosen duration."""
+
+    def __init__(self, durations):
+        self._vals = []
+        t = 0.0
+        for d in durations:
+            self._vals += [t, t + d]
+            t += d + 100.0
+        self._i = 0
+
+    def __call__(self):
+        v = self._vals[self._i]
+        self._i += 1
+        return v
+
+
+def test_pick_decode_bblock_deterministic_under_fake_timer():
+    # medians per candidate: bb=1 -> 5, bb=4 -> 2, bb=8 -> 9  => picks 4
+    durs = [5, 5, 5, 2, 2, 2, 9, 9, 9]
+    calls = []
+    timer = _FakeTimer(durs)
+    got = pick_decode_bblock([1, 4, 8], calls.append, timer=timer, reps=3)
+    assert got == 4
+    # 1 warmup + 3 timed calls per candidate, in candidate order
+    assert calls == [1, 1, 1, 1, 4, 4, 4, 4, 8, 8, 8, 8]
+    # identical script => identical choice (determinism, not luck)
+    assert pick_decode_bblock([1, 4, 8], lambda bb: None,
+                              timer=_FakeTimer(durs), reps=3) == 4
+
+
+def test_pick_decode_bblock_tie_prefers_smaller():
+    # equal medians everywhere: strict < keeps the first (smallest) block —
+    # the conservative choice when the bench can't tell candidates apart
+    durs = [3] * 9
+    assert pick_decode_bblock([1, 4, 8], lambda bb: None,
+                              timer=_FakeTimer(durs), reps=3) == 1
+
+
+def test_microbench_never_runs_under_cpu(monkeypatch):
+    """JAX_PLATFORMS=cpu (the tier-1 substrate) must never pay a microbench:
+    the guard short-circuits to bb=1 before _bblock_bench_once is reachable."""
+    eng_mod._BBLOCK_CACHE.clear()
+
+    def boom(self, bb):
+        raise AssertionError("microbench ran under JAX_PLATFORMS=cpu")
+
+    monkeypatch.setattr(Engine, "_bblock_bench_once", boom)
+    engine = _mk_engine()
+    assert engine.decode_bblock == 1
+    assert not eng_mod._BBLOCK_CACHE   # nothing was tuned, nothing cached
+
+
+def test_autotune_selects_and_caches(monkeypatch):
+    """With the guard faked open: first engine start runs the bench and
+    caches per (batch, page_size, kv_dtype); a second identical start is a
+    pure cache hit (zero bench calls)."""
+    eng_mod._BBLOCK_CACHE.clear()
+    calls = []
+    # bb=8 fastest in the script: medians 9 (bb=1), 5 (bb=4), 2 (bb=8)
+    timer = _FakeTimer([9, 9, 9, 5, 5, 5, 2, 2, 2])
+    monkeypatch.setattr(Engine, "_bblock_autotune_supported",
+                        lambda self: True)
+    monkeypatch.setattr(Engine, "_bblock_bench_once",
+                        lambda self, bb: calls.append(bb))
+    monkeypatch.setattr(Engine, "_bblock_timer", staticmethod(timer))
+    e1 = _mk_engine()
+    assert e1.decode_bblock == 8
+    n_first = len(calls)
+    assert n_first == 12   # (1 warmup + 3 reps) x 3 candidates
+    e2 = _mk_engine()      # same (slots, page_size, kv_dtype) => cache hit
+    assert e2.decode_bblock == 8
+    assert len(calls) == n_first, "second engine start re-ran the microbench"
+    assert eng_mod._BBLOCK_CACHE[(8, 8, "bf16")] == 8
+
+
+def test_candidates_filtered_to_slot_divisors(monkeypatch):
+    """slots=6: candidate 4 and 8 don't divide the batch — only 1 may be
+    benched (and any cached/pinned value must clamp to a divisor)."""
+    eng_mod._BBLOCK_CACHE.clear()
+    calls = []
+    monkeypatch.setattr(Engine, "_bblock_autotune_supported",
+                        lambda self: True)
+    monkeypatch.setattr(Engine, "_bblock_bench_once",
+                        lambda self, bb: calls.append(bb))
+    monkeypatch.setattr(Engine, "_bblock_timer",
+                        staticmethod(_FakeTimer([1] * 3)))
+    engine = _mk_engine(slots=6)
+    assert engine.decode_bblock == 1
+    assert set(calls) <= {1}
+
+
+def test_explicit_pin_skips_bench(monkeypatch):
+    """A positive ServingConfig.decode_bblock (or PALLAS_DECODE_BBLOCK env)
+    pins the block: no microbench even where supported, value clamped to
+    the largest divisor of the slot count."""
+    eng_mod._BBLOCK_CACHE.clear()
+
+    def boom(self, bb):
+        raise AssertionError("pinned config must never bench")
+
+    monkeypatch.setattr(Engine, "_bblock_autotune_supported",
+                        lambda self: True)
+    monkeypatch.setattr(Engine, "_bblock_bench_once", boom)
+    assert _mk_engine(decode_bblock=4).decode_bblock == 4
+    assert _mk_engine(slots=6, decode_bblock=8).decode_bblock == 6  # clamp
+    monkeypatch.setenv("PALLAS_DECODE_BBLOCK", "2")
+    assert _mk_engine(decode_bblock=4).decode_bblock == 2  # env wins (A/B)
+
+
+def test_bblock_reported_on_gauge_and_used_by_decode():
+    """The resolved block lands on the tpu_serve_decode_bblock gauge and the
+    engine actually decodes with it (end-to-end through the paged pallas
+    interpret path)."""
+    eng_mod._BBLOCK_CACHE.clear()
+    engine = _mk_engine(decode_bblock=4, attention_impl="pallas")
+    assert engine.decode_bblock == 4
+    rendered = engine.metrics.registry.render()
+    assert "tpu_serve_decode_bblock 4.0" in rendered
+    from aws_k8s_ansible_provisioner_tpu.serving.engine import Request
+
+    req = engine.submit(Request(prompt_ids=[3, 4, 5], max_tokens=4,
+                                ignore_eos=True))
+    stop = threading.Event()
+    for _ in range(32):
+        engine.step()
+        if req.finish_reason:
+            break
+    assert len(req.generated) == 4
+    stop.set()

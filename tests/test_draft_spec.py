@@ -30,7 +30,7 @@ def _serving(**over):
     base = dict(max_decode_slots=4, max_cache_len=128, prefill_buckets=(32,),
                 dtype="float32", prefix_cache=False, decode_horizon=6)
     base.update(over)
-    return ServingConfig(**base)
+    return ServingConfig(weights_dtype="bf16", **base)
 
 
 def _drive(eng, reqs):

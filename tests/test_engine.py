@@ -55,7 +55,7 @@ def naive_greedy(params, cfg, prompt, n):
 def setup(request):
     cfg = {"qwen3": tiny_qwen3, "phi": tiny_phi, "opt": tiny_opt}[request.param]()
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
-    serving = ServingConfig(max_decode_slots=4, max_cache_len=64,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=64,
                             prefill_buckets=(8, 16, 32), dtype="float32")
     return cfg, params, serving
 
@@ -131,8 +131,13 @@ def test_extra_eos_ids_stop_generation(setup):
                     if expected[i] not in expected[:i]), None)
     if stop_at is None:
         pytest.skip("degenerate stream: all tokens identical")
-    # the stopping id arrives via extra_eos_token_ids, NOT the primary eos
-    cfg2 = cfg.scaled(eos_token_id=cfg.vocab_size - 1,
+    # the stopping id arrives via extra_eos_token_ids, NOT the primary eos —
+    # whose placeholder must not itself appear in the expected stream (the
+    # phi family's greedy stream opens with vocab_size - 1, which made the
+    # old hard-coded placeholder a REAL stop at position 0)
+    placeholder = next(v for v in range(cfg.vocab_size - 1, -1, -1)
+                       if v not in expected)
+    cfg2 = cfg.scaled(eos_token_id=placeholder,
                       extra_eos_token_ids=(expected[stop_at],))
     engine = Engine(cfg2, params, serving)
     req = Request(prompt_ids=list(prompt), max_tokens=16)

@@ -29,7 +29,7 @@ def setup(cpu_devices):
     # heads/kv-heads/vocab sized so the tp=2 split is real (GQA preserved)
     cfg = tiny_qwen3(num_heads=4, num_kv_heads=2, vocab_size=256)
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
-    serving = ServingConfig(max_decode_slots=4, max_cache_len=64,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=64,
                             prefill_buckets=(8, 16), dtype="float32")
     return cfg, params, serving
 
@@ -333,7 +333,7 @@ def test_mesh_guided_decoding_valid_json(setup):
     cfg = _tq(vocab_size=260, eos_token_id=tok.eos_token_id,
               num_heads=4, num_kv_heads=2)
     params = _ip(cfg, _jax.random.PRNGKey(0), dtype=_jnp.float32)
-    serving = ServingConfig(max_decode_slots=4, max_cache_len=128,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=128,
                             prefill_buckets=(16, 32), dtype="float32",
                             decode_horizon=4)
     eng = Engine(cfg, params, serving, mesh=_mesh(2, 2))

@@ -52,7 +52,7 @@ def _engine_greedy(cfg, params, prompt, n_new, **serving_over):
     base = dict(max_decode_slots=2, max_cache_len=128, prefill_buckets=(16,),
                 dtype="float32", prefix_cache=False, decode_horizon=4)
     base.update(serving_over)
-    eng = Engine(cfg, params, ServingConfig(**base))
+    eng = Engine(cfg, params, ServingConfig(weights_dtype="bf16", **base))
     req = eng.submit(Request(prompt_ids=list(prompt), max_tokens=n_new,
                              ignore_eos=True))
     for _ in range(10000):

@@ -210,7 +210,7 @@ def engine():
     tok = ByteTokenizer()
     cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
-    serving = ServingConfig(max_decode_slots=4, max_cache_len=128,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=128,
                             prefill_buckets=(16, 32), dtype="float32",
                             decode_horizon=8)
     eng = Engine(cfg, params, serving)
@@ -305,7 +305,7 @@ def server():
     tok = ByteTokenizer()
     cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
-    serving = ServingConfig(model=MODEL_NAME, max_decode_slots=4,
+    serving = ServingConfig(weights_dtype="bf16", model=MODEL_NAME, max_decode_slots=4,
                             max_cache_len=128, prefill_buckets=(16, 32, 64),
                             dtype="float32")
     state = build_state(serving, model_cfg=cfg, params=params, tokenizer=tok)
@@ -440,7 +440,7 @@ def test_guided_neighbor_does_not_disable_spec():
     tok = ByteTokenizer()
     cfg = _tq(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
-    serving = ServingConfig(max_decode_slots=4, max_cache_len=128,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=128,
                             prefill_buckets=(32,), dtype="float32",
                             prefix_cache=False, decode_horizon=4,
                             spec_decode=True, spec_k=4, spec_ngram=3)

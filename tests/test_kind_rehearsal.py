@@ -135,7 +135,7 @@ def test_l4_request_sequence_offline():
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     model = "tiny-qwen3"
     state = build_state(
-        ServingConfig(model=model, max_decode_slots=2, max_cache_len=64,
+        ServingConfig(weights_dtype="bf16", model=model, max_decode_slots=2, max_cache_len=64,
                       prefill_buckets=(16, 32), dtype="float32"),
         model_cfg=cfg, params=params, tokenizer=tok)
     ready, stop = threading.Event(), threading.Event()

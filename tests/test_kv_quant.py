@@ -148,7 +148,7 @@ def test_engine_int8_token_parity_across_backends(impl):
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
     rng = np.random.default_rng(5)
     prompts = [rng.integers(2, cfg.vocab_size, n).tolist() for n in (3, 9, 14)]
-    base = ServingConfig(max_decode_slots=4, max_cache_len=64,
+    base = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=64,
                          prefill_buckets=(16,), dtype="float32",
                          kv_dtype="int8", attention_impl="xla",
                          prefix_cache=False)
@@ -174,7 +174,7 @@ def test_engine_int8_mesh_token_parity(cpu_devices, sp):
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
     rng = np.random.default_rng(9)
     prompts = [rng.integers(2, cfg.vocab_size, n).tolist() for n in (3, 9, 14)]
-    base = ServingConfig(max_decode_slots=4, max_cache_len=64,
+    base = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=64,
                          prefill_buckets=(16,), dtype="float32",
                          kv_dtype="int8", attention_impl="pallas",
                          prefix_cache=False)
@@ -198,7 +198,7 @@ def test_engine_int8_prefix_cache_copies_scales():
     rng = np.random.default_rng(6)
     seed = rng.integers(2, cfg.vocab_size, 40).tolist()
     ext = seed + rng.integers(2, cfg.vocab_size, 6).tolist()
-    serving = ServingConfig(max_decode_slots=4, max_cache_len=64,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=64,
                             prefill_buckets=(64,), dtype="float32",
                             kv_dtype="int8", attention_impl="xla",
                             prefix_cache=True, prefix_cache_min_len=8,

@@ -21,7 +21,7 @@ from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
 
 
 def _base(**kw):
-    return ServingConfig(max_decode_slots=4, max_cache_len=128,
+    return ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=128,
                          prefill_buckets=(32,), dtype="float32",
                          prefix_cache=False, decode_horizon=4, **kw)
 

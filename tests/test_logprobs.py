@@ -32,7 +32,7 @@ def _drain(eng):
 def test_engine_logprobs_aligned_and_correct():
     cfg = tiny_qwen3()
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
-    serving = ServingConfig(max_decode_slots=2, max_cache_len=64,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=2, max_cache_len=64,
                             prefill_buckets=(16,), dtype="float32",
                             attention_impl="xla", prefix_cache=False)
     eng = Engine(cfg, params, serving)
@@ -66,7 +66,7 @@ def test_engine_logprobs_mixed_batch_and_chunked():
     supplies the first token's logprobs too."""
     cfg = tiny_qwen3()
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
-    serving = ServingConfig(max_decode_slots=2, max_cache_len=64,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=2, max_cache_len=64,
                             prefill_buckets=(16,), dtype="float32",
                             attention_impl="xla", prefix_cache=False,
                             prefill_chunk=8)
@@ -88,7 +88,7 @@ def server():
     tok = ByteTokenizer()
     cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
-    serving = ServingConfig(model="tiny-qwen3", max_decode_slots=4,
+    serving = ServingConfig(weights_dtype="bf16", model="tiny-qwen3", max_decode_slots=4,
                             max_cache_len=128, prefill_buckets=(16, 32),
                             dtype="float32")
     state = build_state(serving, model_cfg=cfg, params=params, tokenizer=tok)

@@ -67,7 +67,7 @@ def _serving(**over):
     base = dict(max_decode_slots=4, max_cache_len=64, prefill_buckets=(16,),
                 dtype="float32", prefix_cache=False, decode_horizon=4)
     base.update(over)
-    return ServingConfig(**base)
+    return ServingConfig(weights_dtype="bf16", **base)
 
 
 def _stream(eng, prompt, n=16, **kw):
@@ -191,7 +191,7 @@ def test_http_serves_adapters_as_models(tmp_path):
     cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
     path = _write_adapter(tmp_path, "styl", cfg, seed=5)
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
-    serving = ServingConfig(model="base-model", max_decode_slots=2,
+    serving = ServingConfig(weights_dtype="bf16", model="base-model", max_decode_slots=2,
                             max_cache_len=64, prefill_buckets=(16,),
                             dtype="float32",
                             lora_adapters=(f"styl={path}",))

@@ -298,7 +298,7 @@ def test_engine_caps_cache_at_model_position_range():
 
     cfg = tiny_opt(max_seq_len=64)
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
-    eng = Engine(cfg, params, ServingConfig(
+    eng = Engine(cfg, params, ServingConfig(weights_dtype="bf16", 
         max_decode_slots=2, max_cache_len=512, prefill_buckets=(8,),
         dtype="float32"))
     assert eng.max_len == 64
@@ -319,7 +319,7 @@ def test_gemma_engine_decode_pallas_mqa():
     prompts = [rng.integers(2, cfg.vocab_size, n).tolist() for n in (3, 9)]
 
     def run(impl):
-        eng = Engine(cfg, params, ServingConfig(
+        eng = Engine(cfg, params, ServingConfig(weights_dtype="bf16", 
             max_decode_slots=2, max_cache_len=64, prefill_buckets=(16,),
             dtype="float32", attention_impl=impl, prefix_cache=False))
         reqs = [eng.submit(Request(prompt_ids=list(p), max_tokens=6,

@@ -195,7 +195,7 @@ def test_engine_moe_end_to_end(impl):
 
     cfg = tiny_qwen3_moe(moe_impl=impl, moe_capacity_factor=8.0)
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
-    serving = ServingConfig(max_decode_slots=4, max_cache_len=64,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=64,
                             prefill_buckets=(16,), dtype="float32",
                             attention_impl="xla", prefix_cache=False)
     eng = Engine(cfg, params, serving)
@@ -217,7 +217,7 @@ def test_engine_moe_impl_forced_gshard_under_mesh(cpu_devices):
 
     cfg = tiny_qwen3_moe()           # default ragged
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
-    serving = ServingConfig(max_decode_slots=4, max_cache_len=64,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=64,
                             prefill_buckets=(16,), dtype="float32",
                             attention_impl="xla", prefix_cache=False)
     mesh = make_mesh(MeshConfig(dp=2, ep=2), devices=cpu_devices)

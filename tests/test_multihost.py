@@ -16,6 +16,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 import numpy as np
 import pytest
 
@@ -36,7 +38,12 @@ def _env(n_devices: int):
     return env
 
 
+@pytest.mark.slow
 def test_two_process_mesh_matches_single_process():
+    # slow AND capability-gated: the pinned jaxlib 0.4.x CPU backend rejects
+    # multi-process computations outright ("Multiprocess computations aren't
+    # implemented on the CPU backend") — on images with the CPU collectives
+    # plugin this runs; under tier-1 it cannot, so it lives behind -m slow.
     port = _free_port()
     cmd = [sys.executable, "-m",
            "aws_k8s_ansible_provisioner_tpu.parallel.multihost",

@@ -33,7 +33,7 @@ def _engine(model, **kw):
     base = dict(max_decode_slots=8, max_cache_len=64, page_size=PS,
                 prefill_buckets=(8, 16, 32), dtype="float32", paged=True)
     base.update(kw)
-    return Engine(cfg, params, ServingConfig(**base))
+    return Engine(cfg, params, ServingConfig(weights_dtype="bf16", **base))
 
 
 def _drain(eng):
@@ -45,7 +45,7 @@ def _drain(eng):
 def _greedy_reference(model, prompt, n):
     """Generate through a roomy DENSE engine — the correctness oracle."""
     cfg, params = model
-    eng = Engine(cfg, params, ServingConfig(
+    eng = Engine(cfg, params, ServingConfig(weights_dtype="bf16", 
         max_decode_slots=2, max_cache_len=64, prefill_buckets=(8, 16, 32),
         dtype="float32", paged=False))
     r = eng.submit(Request(prompt_ids=list(prompt), max_tokens=n,
@@ -250,7 +250,7 @@ def test_prefill_fairness_floor_keeps_decode_flowing(model):
     cfg, params = model
 
     def run(fairness):
-        eng = Engine(cfg, params, ServingConfig(
+        eng = Engine(cfg, params, ServingConfig(weights_dtype="bf16", 
             max_decode_slots=2, max_cache_len=64, page_size=PS,
             prefill_buckets=(8, 16, 32), dtype="float32",
             decode_horizon=8, prefill_fairness=fairness,

@@ -43,7 +43,7 @@ def test_nonpositive_repetition_penalty_rejected_at_submit():
     signs for a direct engine/bench caller (advisor r4)."""
     cfg = tiny_qwen3()
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
-    serving = ServingConfig(max_decode_slots=1, max_cache_len=64,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=1, max_cache_len=64,
                             prefill_buckets=(16,), dtype="float32",
                             prefix_cache=False)
     eng = Engine(cfg, params, serving)
@@ -56,7 +56,7 @@ def test_nonpositive_repetition_penalty_rejected_at_submit():
 def test_heavy_penalty_breaks_greedy_loops():
     cfg = tiny_qwen3()
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
-    serving = ServingConfig(max_decode_slots=2, max_cache_len=64,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=2, max_cache_len=64,
                             prefill_buckets=(16,), dtype="float32",
                             attention_impl="xla", prefix_cache=False)
     plain, eng0 = _run(cfg, params, serving, 0.0)
@@ -73,7 +73,7 @@ def test_penalty_slot_recycling_resets_counts():
     occupant."""
     cfg = tiny_qwen3()
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
-    serving = ServingConfig(max_decode_slots=1, max_cache_len=64,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=1, max_cache_len=64,
                             prefill_buckets=(16,), dtype="float32",
                             attention_impl="xla", prefix_cache=False)
     eng = Engine(cfg, params, serving)
@@ -138,7 +138,7 @@ def test_apply_repetition_matches_hf_processor():
 def test_repetition_penalty_changes_stream_and_off_is_noop():
     cfg = tiny_qwen3()
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
-    base = ServingConfig(max_decode_slots=2, max_cache_len=64,
+    base = ServingConfig(weights_dtype="bf16", max_decode_slots=2, max_cache_len=64,
                          prefill_buckets=(16,), dtype="float32",
                          prefix_cache=False)
     prompt = [5, 9, 2, 5, 9, 2]
@@ -173,7 +173,7 @@ def test_repetition_penalty_neighbor_keeps_spec():
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
     rng = np.random.default_rng(3)
     pat = rng.integers(2, cfg.vocab_size, 4).tolist()
-    base = ServingConfig(max_decode_slots=4, max_cache_len=128,
+    base = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=128,
                          prefill_buckets=(32,), dtype="float32",
                          prefix_cache=False, decode_horizon=4)
     spec = _dc.replace(base, spec_decode=True, spec_k=4, spec_ngram=3)

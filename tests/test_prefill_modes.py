@@ -27,7 +27,7 @@ from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
 def setup():
     cfg = tiny_qwen3()
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
-    serving = ServingConfig(max_decode_slots=4, max_cache_len=64,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=64,
                             prefill_buckets=(8, 16, 32), dtype="float32")
     return cfg, params, serving
 

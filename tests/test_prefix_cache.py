@@ -28,7 +28,7 @@ def setup():
     # payback_rows=1 disables the dispatch-economics gate so these tests
     # exercise the copy/suffix machinery with short prompts; the gate itself
     # is covered by test_payback_gate_*.
-    serving = ServingConfig(max_decode_slots=4, max_cache_len=128,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=128,
                             prefill_buckets=(16, 64), dtype="float32",
                             prefix_cache_min_len=8,
                             prefix_cache_payback_rows=1, paged=False)

@@ -30,7 +30,7 @@ def _serving(**over):
     base = dict(max_decode_slots=4, max_cache_len=64, prefill_buckets=(16,),
                 dtype="float32", decode_horizon=4)
     base.update(over)
-    return ServingConfig(**base)
+    return ServingConfig(weights_dtype="bf16", **base)
 
 
 def _reference_plp(prompt, k):
@@ -125,7 +125,7 @@ def server():
     tok = ByteTokenizer()
     cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
-    serving = ServingConfig(model="plp-model", max_decode_slots=4,
+    serving = ServingConfig(weights_dtype="bf16", model="plp-model", max_decode_slots=4,
                             max_cache_len=128, prefill_buckets=(16, 32),
                             dtype="float32")
     state = build_state(serving, model_cfg=cfg, params=params, tokenizer=tok)

@@ -35,7 +35,7 @@ def _start_engine(port):
     tok = ByteTokenizer()
     cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
-    serving = ServingConfig(model=MODEL_NAME, max_decode_slots=4,
+    serving = ServingConfig(weights_dtype="bf16", model=MODEL_NAME, max_decode_slots=4,
                             max_cache_len=128, prefill_buckets=(16, 32, 64),
                             dtype="float32")
     state = build_state(serving, model_cfg=cfg, params=params, tokenizer=tok)

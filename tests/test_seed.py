@@ -28,7 +28,7 @@ def _drain(eng):
 
 def _engine(model):
     cfg, params = model
-    return Engine(cfg, params, ServingConfig(
+    return Engine(cfg, params, ServingConfig(weights_dtype="bf16", 
         max_decode_slots=4, max_cache_len=64, prefill_buckets=(8, 16),
         dtype="float32"))
 
@@ -97,7 +97,7 @@ def test_seeded_stream_survives_preemption(model):
     pure cache rebuild; the draw counter convention makes position keys
     identical either way)."""
     cfg, params = model
-    mk = lambda: Engine(cfg, params, ServingConfig(
+    mk = lambda: Engine(cfg, params, ServingConfig(weights_dtype="bf16", 
         max_decode_slots=4, max_cache_len=64, page_size=8,
         prefill_buckets=(8, 16), dtype="float32", paged=True,
         kv_pool_pages=32))

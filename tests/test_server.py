@@ -29,7 +29,7 @@ def server():
     tok = ByteTokenizer()
     cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
-    serving = ServingConfig(model=MODEL_NAME, max_decode_slots=4,
+    serving = ServingConfig(weights_dtype="bf16", model=MODEL_NAME, max_decode_slots=4,
                             max_cache_len=128,
                             prefill_buckets=(16, 32, 64), dtype="float32")
     state = build_state(serving, model_cfg=cfg, params=params, tokenizer=tok)
@@ -288,7 +288,7 @@ def test_engine_stall_detection():
 
     cfg = tiny_qwen3()
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
-    eng = Engine(cfg, params, ServingConfig(
+    eng = Engine(cfg, params, ServingConfig(weights_dtype="bf16", 
         max_decode_slots=2, max_cache_len=64, prefill_buckets=(16,),
         dtype="float32"))
     assert eng.stalled_for_s == 0.0                      # idle

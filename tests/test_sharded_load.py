@@ -155,8 +155,8 @@ def test_tp8_serving_config_runnable(cfg, hf_dir, cpu_devices):
     base = dict(model="test-tiny-qwen3", checkpoint_dir=str(hf_dir),
                 max_decode_slots=4, max_cache_len=64,
                 prefill_buckets=(8, 16), dtype="float32")
-    expected = run(ServingConfig(**base))
-    got = run(ServingConfig(**base, mesh=MeshConfig(dp=2, tp=2)))
+    expected = run(ServingConfig(weights_dtype="bf16", **base))
+    got = run(ServingConfig(weights_dtype="bf16", **base, mesh=MeshConfig(dp=2, tp=2)))
     assert got == expected
     assert all(len(g) == 6 for g in got)
 

@@ -58,7 +58,7 @@ def test_engine_windowed_decode_parity_pallas_vs_xla(kv):
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
     rng = np.random.default_rng(1)
     prompts = [rng.integers(2, cfg.vocab_size, n).tolist() for n in (3, 13)]
-    base = ServingConfig(max_decode_slots=2, max_cache_len=64,
+    base = ServingConfig(weights_dtype="bf16", max_decode_slots=2, max_cache_len=64,
                          prefill_buckets=(16,), dtype="float32",
                          attention_impl="xla", kv_dtype=kv,
                          prefix_cache=False)
@@ -76,7 +76,7 @@ def test_engine_windowed_chunked_prefill_parity():
     params = init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
     rng = np.random.default_rng(3)
     prompt = rng.integers(2, cfg.vocab_size, 40).tolist()
-    base = ServingConfig(max_decode_slots=2, max_cache_len=64,
+    base = ServingConfig(weights_dtype="bf16", max_decode_slots=2, max_cache_len=64,
                          prefill_buckets=(64,), dtype="float32",
                          attention_impl="xla", prefix_cache=False)
     ref = _run(cfg, params, base, [prompt], max_tokens=6)
@@ -89,7 +89,7 @@ def test_spec_decode_windowed_stream_identity():
     cfg = tiny_mistral()
     params = init_params(cfg, jax.random.PRNGKey(4), jnp.float32)
     pat = [3, 4, 5, 6] * 4
-    base = ServingConfig(max_decode_slots=2, max_cache_len=64,
+    base = ServingConfig(weights_dtype="bf16", max_decode_slots=2, max_cache_len=64,
                          prefill_buckets=(16,), dtype="float32",
                          attention_impl="pallas", prefix_cache=False,
                          decode_horizon=4)
@@ -105,7 +105,7 @@ def test_window_rejects_sp_mesh(cpu_devices):
 
     cfg = tiny_mistral()
     params = init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
-    serving = ServingConfig(max_decode_slots=4, max_cache_len=64,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=64,
                             prefill_buckets=(16,), dtype="float32")
     mesh = make_mesh(MeshConfig(dp=2, sp=2), devices=cpu_devices[:4])
     with pytest.raises(ValueError, match="sliding-window"):

@@ -47,7 +47,7 @@ def test_greedy_stream_identical_with_and_without_spec(impl, kv):
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
     rng = np.random.default_rng(1)
     prompts = _prompts(cfg, rng)
-    base = ServingConfig(max_decode_slots=4, max_cache_len=128,
+    base = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=128,
                          prefill_buckets=(32,), dtype="float32",
                          attention_impl=impl, kv_dtype=kv,
                          prefix_cache=False, decode_horizon=4)
@@ -67,7 +67,7 @@ def test_spec_step_accepts_correct_drafts_and_rejects_wrong():
     accepted (+1 bonus); feed garbage → exactly 1 token, same as plain."""
     cfg = tiny_qwen3()
     params = init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
-    serving = ServingConfig(max_decode_slots=2, max_cache_len=64,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=2, max_cache_len=64,
                             prefill_buckets=(16,), dtype="float32",
                             attention_impl="xla", prefix_cache=False,
                             decode_horizon=1)
@@ -129,7 +129,7 @@ def test_spec_under_tp_mesh_token_parity(cpu_devices):
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
     rng = np.random.default_rng(7)
     prompts = _prompts(cfg, rng)
-    base = ServingConfig(max_decode_slots=4, max_cache_len=128,
+    base = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=128,
                          prefill_buckets=(32,), dtype="float32",
                          attention_impl="pallas", prefix_cache=False,
                          decode_horizon=4)
@@ -163,7 +163,7 @@ def test_spec_parity_under_dp_mesh(cpu_devices, dp, tp):
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
     rng = np.random.default_rng(8)
     prompts = _prompts(cfg, rng)
-    base = ServingConfig(max_decode_slots=4, max_cache_len=128,
+    base = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=128,
                          prefill_buckets=(32,), dtype="float32",
                          attention_impl="pallas",
                          prefix_cache=False, decode_horizon=4)
@@ -194,7 +194,7 @@ def test_logprobs_neighbor_does_not_disable_spec():
     rng = np.random.default_rng(9)
     pat = rng.integers(2, cfg.vocab_size, 4).tolist()
     prompts = [pat * 4, pat * 3, rng.integers(2, cfg.vocab_size, 9).tolist()]
-    base = ServingConfig(max_decode_slots=4, max_cache_len=128,
+    base = ServingConfig(weights_dtype="bf16", max_decode_slots=4, max_cache_len=128,
                          prefill_buckets=(32,), dtype="float32",
                          prefix_cache=False, decode_horizon=4)
 
@@ -228,7 +228,7 @@ def test_spec_near_window_edge_falls_back():
     decode path (no out-of-window draft writes)."""
     cfg = tiny_qwen3()
     params = init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
-    serving = ServingConfig(max_decode_slots=2, max_cache_len=32,
+    serving = ServingConfig(weights_dtype="bf16", max_decode_slots=2, max_cache_len=32,
                             prefill_buckets=(16,), dtype="float32",
                             attention_impl="xla", prefix_cache=False,
                             spec_decode=True, spec_k=4, spec_ngram=2,

@@ -157,7 +157,7 @@ def test_quantized_greedy_stream_mostly_tracks_fp():
     model — a layout/scale bug diverges immediately and completely."""
     cfg = tiny_qwen3()
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
-    base = ServingConfig(max_decode_slots=2, max_cache_len=64,
+    base = ServingConfig(weights_dtype="bf16", max_decode_slots=2, max_cache_len=64,
                          prefill_buckets=(16,), dtype="float32",
                          prefix_cache=False)
     q = dataclasses.replace(base, weights_dtype="int8")
