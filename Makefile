@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test bench-smoke bench-dry ttft-sweep
+.PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -19,6 +19,21 @@ test:
 bench-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m bench_smoke \
 		-p no:cacheprovider
+
+# Fault-injection suite on CPU (serving/chaos.py + tests/test_chaos.py):
+# every injected fault — connect refused, stalled decode, page-pool
+# exhaustion, slow client, mid-stream disconnect, deadline expiry — must
+# produce its documented degradation behavior. Tier-1 also runs these; this
+# target is the focused pre-push check after touching the robustness layer.
+chaos-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -q \
+		-p no:cacheprovider
+
+# kubeconform (when installed) + structural validation over every rendered
+# deploy/manifests template; rehearse-kind.sh runs the same validator on the
+# exact bytes it applies.
+validate-manifests:
+	$(PY) deploy/validate_manifests.py
 
 # Full bench field-plumbing proof on CPU (tiny model, ~15 s): one JSON line
 # with every real-run field (bblock, weights_dtype, dma_steps_per_substep,

@@ -674,6 +674,33 @@ class ServingConfig:
     spec_k: int = 4
     spec_ngram: int = 3
     max_tokens_default: int = 256
+    # ---- robustness layer (r7): deadlines, admission control, watchdog ----
+    # Default end-to-end deadline (seconds) for requests that don't carry one
+    # (X-Request-Deadline-Ms header / deadline_ms body field); also the CAP
+    # on client-supplied deadlines and the server's wait budget — the single
+    # knob replacing the scattered 600-second literals. 0 disables (no
+    # default deadline, uncapped client deadlines; waits fall back to 600 s).
+    request_timeout_s: float = 600.0
+    # Bounded engine queue: admissions past this depth are shed with 429 +
+    # Retry-After instead of queueing unboundedly (thread pileups, OOM, and
+    # minutes-stale work under overload). 0 = unbounded (pre-r7 behavior).
+    max_queue_depth: int = 256
+    # Estimated-wait shedding: when > 0, a request whose estimated queue wait
+    # (queue_depth x recent avg tokens/request / recent tokens/s) exceeds
+    # this is shed with 429 even below max_queue_depth — the queue never
+    # holds work that would blow its deadline anyway. 0 disables.
+    admission_max_wait_s: float = 0.0
+    # Stall watchdog: a decode step executing past this is declared stalled —
+    # /healthz flips to 503 and the watchdog thread arms the abort flag that
+    # fails the affected requests instead of the process (host-observable
+    # stalls; a truly wedged XLA call still ends at the liveness restart).
+    watchdog_stall_s: float = 120.0
+    # Paged admission pressure relief: when the queue head cannot be placed
+    # (free slot exists, pages don't) for this long, preempt the LOWEST-
+    # progress running request (recompute-resume, requeued at the back) so
+    # admission degrades by policy instead of wedging on page starvation.
+    # 0 disables (head waits for natural page release).
+    admission_preempt_after_s: float = 1.0
     # Prefill/decode fairness: after this many CONSECUTIVE prefill dispatches
     # with decode work pending, the engine forces one full-horizon decode
     # dispatch. Prefill priority otherwise starves in-flight streams under a
@@ -807,6 +834,11 @@ def ansible_vars(cfg: FrameworkConfig | None = None) -> str:
     d["serving_kv_dtype"] = cfg.serving.kv_dtype
     d["serving_weights_dtype"] = cfg.serving.weights_dtype
     d["serving_spec_decode"] = cfg.serving.spec_decode
+    # Robustness knobs (r7): the manifests pass these to the engine CLI so
+    # the deadline/admission behavior is deploy-configurable from the same
+    # single source.
+    d["serving_request_timeout_s"] = cfg.serving.request_timeout_s
+    d["serving_max_queue_depth"] = cfg.serving.max_queue_depth
     lines = ["# generated by aws_k8s_ansible_provisioner_tpu.config — do not edit"]
     for k, v in d.items():
         lines.append(f"{k}: {json.dumps(v)}")

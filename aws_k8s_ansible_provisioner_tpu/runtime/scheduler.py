@@ -118,12 +118,21 @@ def native_available() -> bool:
 
 
 class NativeScheduler:
-    """ctypes wrapper over the C++ runtime core."""
+    """ctypes wrapper over the C++ runtime core.
 
-    def __init__(self, num_slots: int, max_len: int, page_size: int):
+    ``max_queue`` bounds the admission queue (0 = unbounded): the bound is
+    enforced HERE in the shim — the C ABI predates it, and admission control
+    is a host-side policy, not slot bookkeeping. ``submit`` returns False at
+    the bound; ``submit_front`` (preemption resume) is exempt, because a
+    resume returns capacity the queue already accounted for.
+    """
+
+    def __init__(self, num_slots: int, max_len: int, page_size: int,
+                 max_queue: int = 0):
         if not native_available():
             raise RuntimeError("libtpu_serve_runtime.so not built "
                                "(run: make -C native runtime)")
+        self.max_queue = int(max_queue)
         self._lib = _lib_cache["lib"]
         self._rt = self._lib.ts_create(num_slots, max_len, page_size)
         if not self._rt:
@@ -136,6 +145,8 @@ class NativeScheduler:
             self._rt = None
 
     def submit(self, req_id: int, prompt_len: int, max_tokens: int) -> bool:
+        if self.max_queue and self.stats().queue_depth >= self.max_queue:
+            return False
         return self._lib.ts_submit(self._rt, req_id, prompt_len,
                                    max_tokens) == 0
 
@@ -143,6 +154,12 @@ class NativeScheduler:
                      max_tokens: int) -> bool:
         return self._lib.ts_submit_front(self._rt, req_id, prompt_len,
                                          max_tokens) == 0
+
+    def requeue(self, req_id: int, prompt_len: int, max_tokens: int) -> bool:
+        """Back-of-queue submit EXEMPT from the max_queue bound (preemption
+        requeue of already-admitted work must never shed)."""
+        return self._lib.ts_submit(self._rt, req_id, prompt_len,
+                                   max_tokens) == 0
 
     def cancel(self, req_id: int) -> int:
         return self._lib.ts_cancel(self._rt, req_id)
@@ -189,14 +206,21 @@ class NativeScheduler:
 
 
 class PyScheduler:
-    """Pure-Python mirror of the native core (identical semantics)."""
+    """Pure-Python mirror of the native core (identical semantics).
 
-    def __init__(self, num_slots: int, max_len: int, page_size: int):
+    ``max_queue`` bounds the admission queue (0 = unbounded) with the same
+    contract as NativeScheduler's shim-level bound: ``submit`` returns False
+    at the bound, ``submit_front`` (preemption resume) is exempt.
+    """
+
+    def __init__(self, num_slots: int, max_len: int, page_size: int,
+                 max_queue: int = 0):
         if num_slots <= 0 or max_len <= 0 or page_size <= 0:
             raise ValueError("invalid scheduler geometry")
         self.num_slots = num_slots
         self.max_len = max_len
         self.page_size = page_size
+        self.max_queue = int(max_queue)
         self._lock = threading.Lock()
         self._queue: collections.deque = collections.deque()
         self._cancelled_pending: set = set()
@@ -216,6 +240,8 @@ class PyScheduler:
         if prompt_len < 0 or prompt_len + 1 > self.max_len:
             return False
         with self._lock:
+            if self.max_queue and len(self._queue) >= self.max_queue:
+                return False
             self._queue.append((req_id, prompt_len, max_tokens))
         return True
 
@@ -226,6 +252,15 @@ class PyScheduler:
             return False
         with self._lock:
             self._queue.appendleft((req_id, prompt_len, max_tokens))
+        return True
+
+    def requeue(self, req_id: int, prompt_len: int, max_tokens: int) -> bool:
+        """Back-of-queue submit EXEMPT from the max_queue bound (preemption
+        requeue of already-admitted work must never shed)."""
+        if prompt_len < 0 or prompt_len + 1 > self.max_len:
+            return False
+        with self._lock:
+            self._queue.append((req_id, prompt_len, max_tokens))
         return True
 
     def cancel(self, req_id: int) -> int:
@@ -319,12 +354,16 @@ class PyScheduler:
             )
 
 
-def make_scheduler(num_slots: int, max_len: int, page_size: int):
+def make_scheduler(num_slots: int, max_len: int, page_size: int,
+                   max_queue: int = 0):
     """Native core when built, Python fallback otherwise.
 
     TPU_SERVE_NATIVE_RUNTIME=0 forces the fallback (A/B and CI without g++).
+    ``max_queue`` bounds the admission queue (0 = unbounded) — the engine's
+    load-shedding gate; see NativeScheduler/PyScheduler.
     """
     want_native = os.environ.get("TPU_SERVE_NATIVE_RUNTIME", "1") != "0"
     if want_native and native_available():
-        return NativeScheduler(num_slots, max_len, page_size)
-    return PyScheduler(num_slots, max_len, page_size)
+        return NativeScheduler(num_slots, max_len, page_size,
+                               max_queue=max_queue)
+    return PyScheduler(num_slots, max_len, page_size, max_queue=max_queue)

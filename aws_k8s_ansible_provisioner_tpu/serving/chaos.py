@@ -1,0 +1,291 @@
+"""Deterministic fault-injection harness for the serving path.
+
+The serving stack's failure behavior is part of its contract (DeepServe,
+PAPERS.md: serving at scale is dominated by the overload/failure paths, not
+the steady-state kernel). This module makes every defined degradation path
+*drivable* from a test — deterministically, with no timing races — so
+`tests/test_chaos.py` can assert the documented behavior for each fault:
+
+==========================  ==============================================
+fault                       defined degradation behavior
+==========================  ==============================================
+``connect_refused``         router marks the replica dead, fails over to
+                            the next candidate, serves the request (safe:
+                            nothing was sent), recovers the replica via the
+                            poller's health probe
+``stalled_decode``          engine step wedges; /healthz flips to 503
+                            "stalled"; the watchdog aborts the step and the
+                            affected requests fail with "error" — the
+                            process survives and keeps serving
+``page_exhaustion``         page allocation fails; the engine preempts the
+                            lowest-progress request (recompute-resume) or
+                            requeues the admission instead of wedging;
+                            slots/pages fully released, no crash
+``slow_client``             one slow-reading stream consumer backpressures
+                            only its own handler thread; the engine and
+                            sibling requests keep full throughput
+``mid_stream_disconnect``   server cancels the engine request; the slot and
+                            its pages release exactly once
+``deadline``                (engine-native, no injection needed) request
+                            past its deadline is cancelled, slot/pages
+                            released, client gets 408 deadline_exceeded
+==========================  ==============================================
+
+Server-side faults are *injected* through hook points in engine.py /
+router.py / paged_kv.py; client-side faults (slow reader, mid-stream
+disconnect) are *driven* by the socket-level helpers at the bottom, which
+the chaos suite uses as its misbehaving clients.
+
+Injection is programmatic (``chaos.get().inject(...)``) or via env/config:
+``TPU_SERVE_CHAOS="stalled_decode:duration_s=2,page_exhaustion:times=3"``
+— each entry is ``fault[:key=value]*`` with the counting keys ``after``
+(skip the first N trigger sites) and ``times`` (fire for M triggers;
+-1 = forever). Counting is per-process and deterministic: the Nth call to
+:meth:`ChaosController.fire` behaves identically on every run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, Optional
+
+FAULTS = ("connect_refused", "stalled_decode", "page_exhaustion",
+          "slow_client", "mid_stream_disconnect")
+
+
+class InjectedFault(RuntimeError):
+    """Base for failures raised by an armed fault (never raised unarmed)."""
+
+
+class InjectedStall(InjectedFault):
+    """A chaos-stalled decode step aborted by the engine watchdog."""
+
+
+class _FaultSpec:
+    __slots__ = ("name", "after", "times", "params", "triggers", "fired")
+
+    def __init__(self, name: str, after: int = 0, times: int = 1, **params):
+        self.name = name
+        self.after = int(after)     # trigger sites to skip before firing
+        self.times = int(times)     # firings before disarming (-1 = forever)
+        self.params = params
+        self.triggers = 0           # total fire() consultations
+        self.fired = 0              # actual firings
+
+
+class ChaosController:
+    """Process-wide fault registry with deterministic trigger counting."""
+
+    def __init__(self, spec: str = ""):
+        self._lock = threading.Lock()
+        self._specs: Dict[str, _FaultSpec] = {}
+        if spec:
+            self._parse(spec)
+
+    # -- arming --------------------------------------------------------------
+
+    def _parse(self, spec: str):
+        for entry in spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            name, *kvs = entry.split(":")
+            kwargs: Dict[str, object] = {}
+            for kv in kvs:
+                k, _, v = kv.partition("=")
+                try:
+                    kwargs[k] = json.loads(v)
+                except (ValueError, TypeError):
+                    kwargs[k] = v
+            self.inject(name, **kwargs)
+
+    def inject(self, fault: str, after: int = 0, times: int = 1, **params):
+        """Arm ``fault``: skip its first ``after`` trigger sites, then fire
+        for ``times`` triggers (-1 = until cleared)."""
+        if fault not in FAULTS:
+            raise ValueError(f"unknown fault {fault!r}; known: {FAULTS}")
+        with self._lock:
+            self._specs[fault] = _FaultSpec(fault, after=after, times=times,
+                                            **params)
+
+    def clear(self, fault: Optional[str] = None):
+        with self._lock:
+            if fault is None:
+                self._specs.clear()
+            else:
+                self._specs.pop(fault, None)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._specs)
+
+    def active(self, fault: str) -> Optional[dict]:
+        """The fault's params if armed (without consuming a trigger)."""
+        with self._lock:
+            s = self._specs.get(fault)
+            return dict(s.params) if s is not None else None
+
+    def fire(self, fault: str) -> Optional[dict]:
+        """Consume one trigger of ``fault``. Returns its params when this
+        trigger fires, else None. Deterministic: depends only on the call
+        count, never on time."""
+        with self._lock:
+            s = self._specs.get(fault)
+            if s is None:
+                return None
+            s.triggers += 1
+            if s.triggers <= s.after:
+                return None
+            if s.times >= 0 and s.fired >= s.times:
+                return None
+            s.fired += 1
+            return dict(s.params)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {n: {"triggers": s.triggers, "fired": s.fired}
+                    for n, s in self._specs.items()}
+
+    # -- server-side hook points ---------------------------------------------
+
+    def on_decode_step(self, engine) -> None:
+        """engine._do_decode entry: an armed ``stalled_decode`` wedges the
+        step (host-side busy-wait standing in for a hung device dispatch)
+        until the watchdog's abort flag flips — then raises InjectedStall,
+        which run_forever turns into failed requests, not a dead process.
+        ``duration_s`` caps the stall so an un-watched engine self-heals."""
+        p = self.fire("stalled_decode")
+        if p is None:
+            return
+        duration = float(p.get("duration_s", 5.0))
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < duration:
+            if getattr(engine, "_stall_abort", False):
+                raise InjectedStall(
+                    "chaos: stalled decode step aborted by watchdog after "
+                    f"{time.monotonic() - t0:.2f}s")
+            time.sleep(0.005)
+
+    def on_engine_step(self, engine) -> None:
+        """engine.step entry: an armed ``page_exhaustion`` makes the page
+        allocators refuse the next ``allocs`` (default 1) allocation calls
+        (paged_kv.PagePool.fail_next_allocs) — exercising the requeue and
+        preempt-under-pressure paths with a pool that is *logically* dry."""
+        p = self.fire("page_exhaustion")
+        if p is None:
+            return
+        n = int(p.get("allocs", 1))
+        for alloc in getattr(engine, "allocators", ()):
+            alloc.fail_next_allocs += n
+
+    def check_connect(self, addr: str) -> None:
+        """router connect phase: an armed ``connect_refused`` raises the
+        same ConnectionRefusedError a dead replica produces, before any
+        bytes leave the router. ``addr_prefix`` restricts it to matching
+        backends."""
+        p = self.fire("connect_refused")
+        if p is None:
+            return
+        prefix = str(p.get("addr_prefix", ""))
+        if prefix and not addr.startswith(prefix):
+            return
+        raise ConnectionRefusedError(f"chaos: injected connect refusal "
+                                     f"for backend {addr}")
+
+
+_controller: Optional[ChaosController] = None
+_controller_lock = threading.Lock()
+
+
+def get() -> ChaosController:
+    """The process-wide controller (created from $TPU_SERVE_CHAOS once)."""
+    global _controller
+    with _controller_lock:
+        if _controller is None:
+            _controller = ChaosController(os.environ.get("TPU_SERVE_CHAOS",
+                                                         ""))
+        return _controller
+
+
+def reset() -> ChaosController:
+    """Fresh controller (tests; re-reads $TPU_SERVE_CHAOS)."""
+    global _controller
+    with _controller_lock:
+        _controller = None
+    return get()
+
+
+# ---------------------------------------------------------------------------
+# Client-side fault drivers (the misbehaving clients the chaos suite runs)
+# ---------------------------------------------------------------------------
+
+
+def _raw_post(host: str, port: int, path: str, payload: dict,
+              timeout: float = 60.0) -> socket.socket:
+    """Open a raw socket and send a POST; returns the connected socket with
+    the response unread — the caller controls read pacing and lifetime."""
+    body = json.dumps(payload).encode()
+    req = (f"POST {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
+           f"Content-Type: application/json\r\n"
+           f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.sendall(req)
+    return sock
+
+
+def stream_then_disconnect(host: str, port: int, payload: dict,
+                           path: str = "/v1/completions",
+                           after_bytes: int = 1,
+                           timeout: float = 60.0) -> bytes:
+    """Mid-stream disconnect driver: start a streaming completion, read at
+    least ``after_bytes`` of the SSE body, then drop the connection with a
+    RST-ish abrupt close. Returns the bytes read before the drop."""
+    payload = {**payload, "stream": True}
+    sock = _raw_post(host, port, path, payload, timeout=timeout)
+    got = b""
+    try:
+        while len(got) < after_bytes:
+            chunk = sock.recv(4096)
+            if not chunk:
+                break
+            got += chunk
+    finally:
+        # SO_LINGER 0: close sends RST, the hard-kill variant of a client
+        # vanishing (wifi drop, OOM-killed consumer)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                            b"\x01\x00\x00\x00\x00\x00\x00\x00")
+        except OSError:
+            pass
+        sock.close()
+    return got
+
+
+def slow_client_stream(host: str, port: int, payload: dict,
+                       path: str = "/v1/completions",
+                       read_delay_s: float = 0.2,
+                       read_size: int = 1,
+                       timeout: float = 120.0) -> bytes:
+    """Slow-consumer driver: stream a completion reading ``read_size`` bytes
+    per ``read_delay_s`` — TCP backpressure against the handler thread.
+    Returns the full body once the server finishes."""
+    payload = {**payload, "stream": True}
+    sock = _raw_post(host, port, path, payload, timeout=timeout)
+    got = b""
+    deadline = time.monotonic() + timeout
+    try:
+        while time.monotonic() < deadline:
+            chunk = sock.recv(max(1, read_size))
+            if not chunk:
+                break
+            got += chunk
+            if b"data: [DONE]" in got:
+                break
+            time.sleep(read_delay_s)
+    finally:
+        sock.close()
+    return got

@@ -61,6 +61,7 @@ from aws_k8s_ansible_provisioner_tpu.ops.attention import (
 from aws_k8s_ansible_provisioner_tpu.ops.sampling import (apply_penalties,
                                                            per_slot_keys,
                                                            sample)
+from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
 from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import EngineMetrics
 
@@ -80,6 +81,21 @@ class ContextLengthExceeded(ValueError):
         super().__init__(
             f"This model's maximum prompt length is {limit} tokens "
             f"(context window {max_len}); your prompt has {n_prompt} tokens.")
+
+
+class EngineOverloaded(RuntimeError):
+    """Admission control shed this request (bounded queue / wait estimate).
+
+    Raised by :meth:`Engine.submit` BEFORE the request enters the queue —
+    nothing was generated, so the caller may safely retry elsewhere/later.
+    The server maps this to ``429`` with a ``Retry-After`` header carrying
+    :attr:`retry_after_s`; the router treats that 429 as a routable signal.
+    """
+
+    def __init__(self, reason: str, message: str, retry_after_s: float = 1.0):
+        self.reason = reason
+        self.retry_after_s = max(1.0, float(retry_after_s))
+        super().__init__(message)
 
 
 @dataclass
@@ -141,6 +157,15 @@ class Request:
     # Guided slots force horizon-1 decode dispatches (the host FSM must see
     # token N before masking token N+1) and are spec-decode-ineligible.
     guided: object = None
+    # End-to-end deadline, RELATIVE seconds from submission (server parses
+    # the X-Request-Deadline-Ms header / deadline_ms body field into this).
+    # None = the engine's default (serving.request_timeout_s). submit()
+    # resolves it into the absolute ``t_deadline``; the engine enforces it
+    # between dispatches — expiry cancels the request, releases its slot and
+    # pages, and finishes it with finish_reason "timeout" (HTTP 408).
+    deadline_s: Optional[float] = None
+    # absolute time.monotonic() deadline, resolved at submit (0.0 = none)
+    t_deadline: float = 0.0
     id: int = field(default_factory=lambda: next(_REQUEST_IDS))
     # Filled in by the engine:
     generated: List[int] = field(default_factory=list)
@@ -1026,7 +1051,9 @@ class Engine:
         from aws_k8s_ansible_provisioner_tpu.runtime import make_scheduler
 
         self.sched = make_scheduler(self.num_slots, self.max_len,
-                                    serving.page_size)
+                                    serving.page_size,
+                                    max_queue=max(0,
+                                                  serving.max_queue_depth))
         self._queued: dict = {}
         self._lock = threading.Lock()
         self._work_event = threading.Event()
@@ -1052,6 +1079,16 @@ class Engine:
         # /healthz and as the tpu_serve_decode_bblock gauge.
         self.decode_bblock = self._resolve_decode_bblock()
         self.metrics.decode_bblock.set(self.decode_bblock)
+        # Robustness layer (r7): stall watchdog + paged-admission pressure
+        # relief. STALL_AFTER_S becomes an instance knob (the class default
+        # stays as documentation/back-compat); _stall_abort is the watchdog's
+        # signal to a chaos-observable stalled step; _admission_blocked_since
+        # tracks how long the queue head has been page-starved while a slot
+        # sat free (the preempt-under-pressure trigger).
+        if serving.watchdog_stall_s > 0:
+            self.STALL_AFTER_S = float(serving.watchdog_stall_s)
+        self._stall_abort = False
+        self._admission_blocked_since = 0.0
 
     # -- decode batch-block autotune ----------------------------------------
 
@@ -1393,12 +1430,13 @@ class Engine:
         self._pages_gauges()
         return bool(self._active_slots())
 
-    def _preempt(self, slot: int):
+    def _preempt(self, slot: int, front: bool = True):
         """Reclaim a running request's pages; it resumes later by
         re-prefilling prompt + generated-so-far (the full pages of that
         context stay in the evictable index, so the resume usually hash-hits
         everything but the tail). The vLLM scheduler's RECOMPUTE preemption,
-        paged-TPU edition."""
+        paged-TPU edition. ``front=False`` (admission pressure relief)
+        requeues at the BACK so the starved queue head admits first."""
         req = self.slot_req[slot]
         ids = req.prompt_ids + req.generated
         # make the resume a prefix hit — but only over fully-WRITTEN pages
@@ -1420,7 +1458,11 @@ class Engine:
         remaining = max(1, req.max_tokens - len(req.generated))
         with self._lock:
             self._queued[req.id] = req
-        self.sched.submit_front(req.id, len(ids), remaining)
+        if front:
+            self.sched.submit_front(req.id, len(ids), remaining)
+        else:
+            # bound-exempt: already-admitted work must never shed on requeue
+            self.sched.requeue(req.id, len(ids), remaining)
         self.metrics.preemptions.inc()
         self.metrics.active_requests.set(len(self._active_slots()))
         self.metrics.queue_depth.set(self.sched.stats().queue_depth)
@@ -1488,12 +1530,64 @@ class Engine:
         # while identical submission orders stay reproducible.
         req.eff_seed = (int(req.seed) & 0xffffffff) if req.seed is not None \
             else self._py_rng.getrandbits(32)
+        # End-to-end deadline: the client's (capped by the server default)
+        # or the server default alone; request_timeout_s <= 0 means no cap
+        # and no default. Resolved to an ABSOLUTE monotonic time here so
+        # queue wait counts against it — a deadline covers the request, not
+        # just its decode.
+        cap = float(self.serving.request_timeout_s or 0)
+        d = req.deadline_s
+        if d is not None and d <= 0:
+            raise ValueError(f"deadline must be > 0 seconds (got {d})")
+        if d is None:
+            d = cap if cap > 0 else None
+        elif cap > 0:
+            d = min(float(d), cap)
+        req.t_deadline = (req.t_submit + d) if d else 0.0
+        # Admission control (r7): shed over-limit work with a structured
+        # overload error BEFORE it queues — bounded queue depth first, then
+        # the estimated-wait gate. Nothing was generated, so shedding is
+        # always retry-safe for the caller.
+        st = self.sched.stats()
+        mw = float(self.serving.admission_max_wait_s or 0)
+        if mw > 0:
+            est = self._estimated_wait_s(st)
+            if est > mw:
+                self.metrics.requests_shed.inc(reason="est_wait")
+                raise EngineOverloaded(
+                    "est_wait",
+                    f"estimated queue wait {est:.1f}s exceeds the "
+                    f"admission limit {mw:.1f}s", retry_after_s=est - mw + 1)
         with self._lock:
             self._queued[req.id] = req
-            self.sched.submit(req.id, len(req.prompt_ids), req.max_tokens)
+            ok = self.sched.submit(req.id, len(req.prompt_ids),
+                                   req.max_tokens)
+            if not ok:
+                # bounded queue (scheduler-enforced so the native core and
+                # Python fallback shed identically under racing submitters)
+                del self._queued[req.id]
             self.metrics.queue_depth.set(self.sched.stats().queue_depth)
+        if not ok:
+            self.metrics.requests_shed.inc(reason="queue_full")
+            raise EngineOverloaded(
+                "queue_full",
+                f"engine queue is full ({st.queue_depth} waiting, "
+                f"limit {self.serving.max_queue_depth})",
+                retry_after_s=self._estimated_wait_s(st) or 1.0)
         self._work_event.set()
         return req
+
+    def _estimated_wait_s(self, st) -> float:
+        """Coarse queue-wait estimate: queued requests x recent average
+        tokens per finished request / recent decode throughput. 0.0 when
+        there is no throughput history yet (cold engines never shed on an
+        estimate)."""
+        tps = self.metrics.tokens_per_second.value()
+        if tps <= 0 or st.queue_depth <= 0:
+            return 0.0
+        done = max(1, st.finished_total)
+        avg_tokens = self.metrics.generated_tokens.total() / done
+        return st.queue_depth * max(1.0, avg_tokens) / tps
 
     def generate(self, prompt_ids: List[int], **kw) -> Request:
         req = Request(prompt_ids=list(prompt_ids), **kw)
@@ -1597,16 +1691,91 @@ class Engine:
         self.sched.cancel(req.id)
         self._work_event.set()
 
+    def _reap_expired(self):
+        """Cancel every request whose end-to-end deadline has passed:
+        running slots finish with "timeout" (slot + pages released through
+        the one _finish path — exactly-once), the in-flight chunk walk is
+        torn down, and queued requests are notified immediately instead of
+        waiting to surface through admission."""
+        now = time.monotonic()
+        for slot, r in enumerate(self.slot_req):
+            if r is not None and r.t_deadline and now >= r.t_deadline:
+                r.finish_reason = "timeout"
+                self.metrics.deadline_expired.inc()
+                self._finish(slot)
+        st = self._chunk
+        if st is not None and st["req"].t_deadline \
+                and now >= st["req"].t_deadline:
+            self._chunk = None
+            req, slot = st["req"], st["slot"]
+            self._release_slot_pages(slot)
+            self.sched.release(slot)
+            req.finish_reason = "timeout"
+            self.metrics.deadline_expired.inc()
+            self.metrics.mark_request("timeout", now - req.t_submit)
+            req.out_queue.put(None)
+        expired = []
+        with self._lock:
+            for rid, r in list(self._queued.items()):
+                if r.t_deadline and now >= r.t_deadline:
+                    expired.append(r)
+                    del self._queued[rid]
+        for r in expired:
+            # the scheduler entry drains later as a "cancelled" pop; the
+            # client is answered NOW with the real reason
+            self.sched.cancel(r.id)
+            if self.paged:
+                self._resume_ctx.pop(r.id, None)
+            r.finish_reason = "timeout"
+            self.metrics.deadline_expired.inc()
+            self.metrics.mark_request("timeout", now - r.t_submit)
+            r.out_queue.put(None)
+        if expired:
+            self.metrics.queue_depth.set(self.sched.stats().queue_depth)
+
+    def _relieve_admission_pressure(self):
+        """Paged admission wedged on page starvation (queue head can't be
+        placed although a slot is free): after admission_preempt_after_s,
+        preempt the LOWEST-progress running request — least recompute lost,
+        requeued at the BACK so the starved head takes the freed pages —
+        instead of letting admission hang on requests that may hold their
+        pages for minutes."""
+        wait = float(self.serving.admission_preempt_after_s or 0)
+        st = self.sched.stats()
+        active = self._active_slots()
+        if (wait <= 0 or st.queue_depth == 0
+                or st.active_slots >= st.num_slots or not active):
+            self._admission_blocked_since = 0.0
+            return
+        now = time.monotonic()
+        if not self._admission_blocked_since:
+            self._admission_blocked_since = now
+            return
+        if now - self._admission_blocked_since < wait:
+            return
+        victim = min(active, key=lambda s: (len(self.slot_req[s].generated),
+                                            -self._admit_seq[s]))
+        self.metrics.admission_preemptions.inc()
+        self._preempt(victim, front=False)
+        self._admission_blocked_since = now
+
     def step(self) -> bool:
         """One scheduling step. Priority: advance a chunked prefill (with one
         decode step interleaved between chunks), else admit waiting prompts
         (batched into one dispatch), else decode. Returns whether any work was
         done."""
+        ch = _chaos.get()
+        if ch.enabled:
+            ch.on_engine_step(self)
         # reap cancelled slots first so disconnected clients free capacity
         for slot, r in enumerate(self.slot_req):
             if r is not None and r.cancelled:
                 r.finish_reason = "cancelled"
                 self._finish(slot)
+        # then expired deadlines — every blocking wait in the pipeline keys
+        # off the same t_deadline, so enforcement here (between dispatches)
+        # is what turns a deadline into released capacity
+        self._reap_expired()
         # A long prompt mid-chunking: alternate chunk and decode dispatches so
         # in-flight streams keep progressing during the prefill (the whole
         # point of chunking — VERDICT r1 missing #4).
@@ -1719,6 +1888,12 @@ class Engine:
                 chunk_next = (req, slot, pref)
                 break
             batch.append((req, slot))
+        if batch or chunk_next is not None:
+            self._admission_blocked_since = 0.0
+        elif self.paged:
+            # nothing admitted although work waits: if a slot is free, the
+            # head is page-starved — degrade by policy, don't wedge
+            self._relieve_admission_pressure()
         if batch:
             self._prefill_streak += 1
             try:
@@ -2243,6 +2418,11 @@ class Engine:
 
     def _do_decode(self, max_horizon: Optional[int] = None,
                    fair_horizon: bool = False):
+        ch = _chaos.get()
+        if ch.enabled:
+            # an armed "stalled_decode" wedges here (standing in for a hung
+            # device dispatch) until the watchdog aborts it — see chaos.py
+            ch.on_decode_step(self)
         t0 = time.monotonic()
         self._prefill_streak = 0
         active = self._active_slots()
@@ -2485,6 +2665,9 @@ class Engine:
         import logging
 
         log = logging.getLogger(__name__)
+        wd = threading.Thread(target=self._watchdog_loop, args=(stop,),
+                              daemon=True, name="engine-watchdog")
+        wd.start()
         while not stop.is_set():
             self.last_step_start = time.monotonic()
             try:
@@ -2495,9 +2678,23 @@ class Engine:
                 self._fail_all(self.last_error)
                 did_work = False
             self.last_step_start = 0.0
+            self._stall_abort = False   # the aborted step has unwound
             if not did_work:
                 self._work_event.wait(timeout=0.05)
                 self._work_event.clear()
+
+    def _watchdog_loop(self, stop: threading.Event):
+        """Stall watchdog (r7): when a step executes past STALL_AFTER_S,
+        arm the abort flag a host-observable stall (chaos-injected or any
+        cooperative wait) checks — the step raises, run_forever fails the
+        AFFECTED requests, and the process keeps serving. A truly wedged
+        device call never sees the flag; for that class /healthz stays 503
+        "stalled" until the K8s liveness restart (the pre-r7 behavior)."""
+        while not stop.is_set():
+            if self.stalled_for_s > 0 and not self._stall_abort:
+                self._stall_abort = True
+                self.metrics.watchdog_stalls.inc()
+            stop.wait(min(1.0, max(0.05, self.STALL_AFTER_S / 4)))
 
     last_error: str = ""
     # monotonic timestamp of the step currently executing (0.0 = idle):

@@ -57,6 +57,11 @@ class Gauge:
         with self._lock:
             self._value += v
 
+    def value(self) -> float:
+        """Current value (admission-control wait estimation, tests)."""
+        with self._lock:
+            return self._value
+
     def collect(self) -> List[str]:
         return [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge",
                 f"{self.name} {self._value}"]
@@ -196,6 +201,25 @@ class EngineMetrics:
         self.decode_bblock = r.register(Gauge(
             "tpu_serve_decode_bblock",
             "Decode kernel batch-block size (slots per grid step)"))
+        # Robustness layer (r7): overload shedding, end-to-end deadlines,
+        # and the stall watchdog each get an explicit first-class signal —
+        # a dashboard must distinguish "we refused work by design" from
+        # "work failed" (DeepServe: the overload path is the product).
+        self.requests_shed = r.register(Counter(
+            "tpu_serve_requests_shed_total",
+            "Requests rejected at admission (429), by reason",
+            ("reason",)))
+        self.deadline_expired = r.register(Counter(
+            "tpu_serve_deadline_expired_total",
+            "Requests cancelled because their end-to-end deadline passed"))
+        self.watchdog_stalls = r.register(Counter(
+            "tpu_serve_watchdog_stalls_total",
+            "Stalled decode steps the watchdog aborted (requests failed, "
+            "process kept alive)"))
+        self.admission_preemptions = r.register(Counter(
+            "tpu_serve_admission_preemptions_total",
+            "Lowest-progress requests preempted to unwedge page-starved "
+            "admission"))
 
     def mark_request(self, status: str, duration_s: float):
         self.request_total.inc(status=status)

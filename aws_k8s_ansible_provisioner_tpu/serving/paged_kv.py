@@ -305,6 +305,11 @@ class PagePool:
         self._free: collections.deque = collections.deque(
             range(first_page, num_pages))
         self._ref = np.zeros(num_pages, np.int32)
+        # Fault-injection hook (serving/chaos.py "page_exhaustion"): while
+        # positive, alloc() refuses and decrements — a logically-dry pool
+        # with deterministic healing, driving the engine's requeue/preempt
+        # degradation paths without filling real HBM.
+        self.fail_next_allocs = 0
         # page id -> (chain_key, tokens tuple) for hash-indexed pages
         self._page_key: Dict[int, Tuple] = {}
         # chain key -> page id (latest content wins)
@@ -341,6 +346,9 @@ class PagePool:
 
     def alloc(self, n: int = 1) -> Optional[List[int]]:
         """Allocate n pages (refcount 1 each), or None if not enough."""
+        if self.fail_next_allocs > 0:
+            self.fail_next_allocs -= 1
+            return None
         if n > self.free_pages:
             return None
         out = []

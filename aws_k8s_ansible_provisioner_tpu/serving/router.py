@@ -46,6 +46,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import (
     Counter, Gauge, Registry)
 
@@ -59,6 +60,19 @@ log = logging.getLogger("tpu_serve.router")
 # generating).
 CONNECT_TIMEOUT_S = 5.0
 READ_TIMEOUT_S = 600.0
+# End-to-end deadline header (serving/server.py DEADLINE_HEADER): forwarded
+# to the backend unchanged AND used to bound this hop's read timeout — a
+# request that declared a 5 s deadline must not pin a router thread for the
+# full READ_TIMEOUT_S when its backend wedges.
+DEADLINE_HEADER = "X-Request-Deadline-Ms"
+READ_TIMEOUT_GRACE_S = 30.0
+# 429 is a ROUTABLE signal: the backend shed the request at admission —
+# nothing was generated — so trying the next replica (or the same pool again
+# after a jittered backoff) is always safe, unlike mid-generation failures.
+# The budget bounds the extra attempts per request; backoff is jittered so a
+# synchronized burst doesn't re-converge on the same replica.
+RETRY_429_BUDGET = 2
+RETRY_429_BACKOFF_S = 0.1
 
 
 class RouterMetrics:
@@ -79,6 +93,14 @@ class RouterMetrics:
             "Times a backend was taken out of rotation"))
         self.backends = r.register(Gauge(
             "tpu_router_backends", "Currently resolved backend replicas"))
+        self.retries_429 = r.register(Counter(
+            "tpu_router_429_retries_total",
+            "Shed (429) responses retried on another replica after a "
+            "jittered backoff"))
+        self.recovered = r.register(Counter(
+            "tpu_router_backend_recovered_total",
+            "Cooling-down backends returned to rotation early after "
+            "answering the health probe"))
 
 
 # A /load sample older than this no longer orders candidates (a replica that
@@ -225,6 +247,21 @@ class BackendPool:
             self._dead[addr] = time.monotonic()
             self._load.pop(addr, None)
 
+    def note_recovered(self, addr: str) -> bool:
+        """A cooling-down replica answered its health probe: return it to
+        rotation NOW instead of waiting out the rest of the cooldown (a
+        restarted pod re-enters within one poller interval). Returns whether
+        the replica was actually cooling."""
+        with self._lock:
+            return self._dead.pop(addr, None) is not None
+
+    def cooling(self) -> list[str]:
+        """Replicas currently inside their cooldown window."""
+        now = time.monotonic()
+        with self._lock:
+            return [a for a, t in self._dead.items()
+                    if now - t < self.cooldown_s]
+
     def url(self, addr: str, path: str) -> str:
         return f"http://{addr}{path}"
 
@@ -269,15 +306,33 @@ def _affinity_key(path: str, body: bytes | None) -> str | None:
 
 
 def start_load_poller(pool: BackendPool, interval_s: float = 1.0,
-                      stop: threading.Event | None = None) -> threading.Thread:
-    """~1 Hz /load poller feeding BackendPool.note_load. A replica that
-    fails the poll just loses its (stale-TTL'd) sample — the request path's
-    connect failures own dead-marking."""
+                      stop: threading.Event | None = None,
+                      metrics: RouterMetrics | None = None
+                      ) -> threading.Thread:
+    """~1 Hz poller: /load samples for alive replicas (feeding
+    BackendPool.note_load) and a /healthz RECOVERY probe for cooling-down
+    ones — a restarted replica that answers healthy again re-enters rotation
+    within one poll interval instead of serving out its whole cooldown
+    (ISSUE r7 satellite; a stalled replica answers 503 and stays out). A
+    failed poll just leaves the replica's sample to the stale-TTL — the
+    request path's connect failures own dead-marking."""
 
-    def poll_one(addr):
+    def poll_one(addr, cooling=False):
         host, _, port = addr.rpartition(":")
         conn = http.client.HTTPConnection(host, int(port), timeout=2.0)
         try:
+            if cooling:
+                # recovery probe: /healthz, not /load — a wedged engine
+                # still answers /load 200 but /healthz 503 ("stalled"),
+                # and it must NOT re-attract traffic
+                conn.request("GET", "/healthz")
+                if conn.getresponse().status == 200 \
+                        and pool.note_recovered(addr):
+                    log.info("backend %s healthy again; back in rotation",
+                             addr)
+                    if metrics is not None:
+                        metrics.recovered.inc()
+                return
             conn.request("GET", "/load")
             resp = conn.getresponse()
             if resp.status == 200:
@@ -290,24 +345,21 @@ def start_load_poller(pool: BackendPool, interval_s: float = 1.0,
             # router would silently degrade to round-robin for its whole
             # lifetime (review r4). A failed poll just leaves the
             # replica's sample to the stale-TTL.
-            log.debug("load poll of %s failed", addr, exc_info=True)
+            log.debug("poll of %s failed", addr, exc_info=True)
         finally:
             conn.close()
 
     def poll_once():
         addrs = pool.addrs()
-        now = time.monotonic()
-        with pool._lock:
-            cooling = {a for a, t in pool._dead.items()
-                       if now - t < pool.cooldown_s}
-        # CONCURRENT polls, skipping cooled-down replicas: a few blackholed
-        # pod IPs during a rolling restart must not stretch the cycle past
-        # LOAD_TTL_S and stale out every healthy sample (review r4)
+        cooling = set(pool.cooling())
+        # CONCURRENT polls (cooling replicas get the cheap recovery probe):
+        # a few blackholed pod IPs during a rolling restart must not stretch
+        # the cycle past LOAD_TTL_S and stale out every healthy sample
+        # (review r4) — the bounded join below caps the cycle either way
         threads = []
         for addr in addrs:
-            if addr in cooling:
-                continue
-            t = threading.Thread(target=poll_one, args=(addr,), daemon=True)
+            t = threading.Thread(target=poll_one,
+                                 args=(addr, addr in cooling), daemon=True)
             t.start()
             threads.append(t)
         for t in threads:
@@ -389,9 +441,25 @@ class RouterHandler(BaseHTTPRequestHandler):
                 "message": "no serving backends resolved", "type": "router_error"}})
             return
         hdrs = {h: self.headers[h]
-                for h in ("Content-Type", "Authorization", "Accept")
+                for h in ("Content-Type", "Authorization", "Accept",
+                          DEADLINE_HEADER)
                 if self.headers.get(h)}
+        # A declared end-to-end deadline bounds THIS hop's read timeout too:
+        # the backend enforces the deadline (408 within it), so waiting the
+        # full READ_TIMEOUT_S past it only pins a router thread on a wedged
+        # replica.
+        read_to = READ_TIMEOUT_S
+        raw_ddl = self.headers.get(DEADLINE_HEADER)
+        if raw_ddl:
+            try:
+                read_to = min(READ_TIMEOUT_S,
+                              max(1.0, float(raw_ddl) / 1000.0)
+                              + READ_TIMEOUT_GRACE_S)
+            except ValueError:
+                pass    # backend rejects the malformed header with a 400
         last_err = None
+        shed = None          # last 429 body, relayed if every retry sheds
+        n_429 = 0
         for i, addr in enumerate(candidates):
             if i > 0:
                 self.metrics.failovers.inc()
@@ -405,6 +473,7 @@ class RouterHandler(BaseHTTPRequestHandler):
             conn = http.client.HTTPConnection(a_host, int(a_port),
                                               timeout=CONNECT_TIMEOUT_S)
             try:
+                _chaos.get().check_connect(addr)   # fault injection hook
                 conn.connect()
             except OSError as e:
                 conn.close()
@@ -420,7 +489,7 @@ class RouterHandler(BaseHTTPRequestHandler):
             # (a retry would duplicate the generation on a second replica);
             # bodyless GETs are idempotent and may fail over.
             try:
-                conn.sock.settimeout(READ_TIMEOUT_S)
+                conn.sock.settimeout(read_to)
                 conn.request(method, self.path, body=body, headers=hdrs)
                 resp = conn.getresponse()
             except OSError as e:
@@ -438,6 +507,32 @@ class RouterHandler(BaseHTTPRequestHandler):
                     return
                 log.warning("backend %s failed (%s); trying next", addr, e)
                 continue
+            # Phase 2.5: a 429 means the backend SHED the request at
+            # admission — nothing was generated, so (unlike any other
+            # post-send failure) retrying on the next replica is safe even
+            # with a body. Jittered backoff, bounded budget; the replica is
+            # NOT marked dead (it is healthy, just full). If every candidate
+            # sheds, the last 429 (with its Retry-After) is the answer.
+            if resp.status == 429:
+                shed = (resp.headers.get("Retry-After"), resp.read())
+                conn.close()
+                if n_429 < RETRY_429_BUDGET and i < len(candidates) - 1:
+                    n_429 += 1
+                    self.metrics.retries_429.inc()
+                    import random as _random
+
+                    time.sleep(RETRY_429_BACKOFF_S
+                               * (0.5 + _random.random()))
+                    continue
+                self.metrics.requests.inc(code="429")
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                if shed[0]:
+                    self.send_header("Retry-After", shed[0])
+                self.send_header("Content-Length", str(len(shed[1])))
+                self.end_headers()
+                self.wfile.write(shed[1])
+                return
             # Phase 3: relay to the client. A 4xx/5xx status is the app's
             # answer, not a dead replica — passed through as-is. A failure
             # while relaying must NOT retry another replica (that would splice
@@ -483,6 +578,18 @@ class RouterHandler(BaseHTTPRequestHandler):
             finally:
                 conn.close()
             return
+        if shed is not None:
+            # every connectable replica shed the request: the honest answer
+            # is the overload signal itself, not a 502
+            self.metrics.requests.inc(code="429")
+            self.send_response(429)
+            self.send_header("Content-Type", "application/json")
+            if shed[0]:
+                self.send_header("Retry-After", shed[0])
+            self.send_header("Content-Length", str(len(shed[1])))
+            self.end_headers()
+            self.wfile.write(shed[1])
+            return
         self.metrics.requests.inc(code="502")
         self._respond_json(502, {"error": {
             "message": f"all backends failed: {last_err}", "type": "router_error"}})
@@ -497,7 +604,7 @@ class RouterHandler(BaseHTTPRequestHandler):
 def serve(backend_service: str, host: str, port: int):
     RouterHandler.pool = BackendPool(backend_service)
     RouterHandler.metrics = RouterMetrics()
-    start_load_poller(RouterHandler.pool)
+    start_load_poller(RouterHandler.pool, metrics=RouterHandler.metrics)
     httpd = ThreadingHTTPServer((host, port), RouterHandler)
     log.info("router listening on %s:%d -> %s", host, port, backend_service)
     httpd.serve_forever()

@@ -28,9 +28,16 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
-from aws_k8s_ansible_provisioner_tpu.serving.engine import ContextLengthExceeded
+from aws_k8s_ansible_provisioner_tpu.serving.engine import (
+    ContextLengthExceeded, EngineOverloaded)
 
 log = logging.getLogger("tpu_serve")
+
+# Wire names for the end-to-end deadline (relative milliseconds): the router
+# forwards the header unchanged and bounds its own read timeout by it, the
+# server parses either form into Request.deadline_s, the engine enforces it.
+DEADLINE_HEADER = "X-Request-Deadline-Ms"
+DEADLINE_FIELD = "deadline_ms"
 
 
 def _now() -> int:
@@ -108,6 +115,19 @@ def _format_logprobs(tokenizer, ids, lp_data, k: int, chat: bool,
             "text_offset": offsets}
 
 
+def _wait_budget_s(engine, req) -> Optional[float]:
+    """Server-side cap for a blocking collect: the request's own deadline
+    plus grace — the ENGINE owns deadline enforcement (cancel + slot/page
+    release + "timeout" finish); this budget is only the backstop that
+    keeps a handler thread from hanging on a wedged engine loop. Without a
+    deadline the configured default (request_timeout_s) applies; a config
+    of 0 means genuinely unbounded (None), not some other magic constant."""
+    if req.t_deadline:
+        return max(1.0, req.t_deadline - time.monotonic()) + 30.0
+    cap = float(engine.serving.request_timeout_s or 0)
+    return cap + 30.0 if cap > 0 else None
+
+
 def _apply_stop_strings(text: str, stops: List[str]) -> Optional[str]:
     """Return text truncated at the earliest stop string, or None if no match."""
     cut = None
@@ -128,19 +148,31 @@ class Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         log.debug("%s - %s", self.address_string(), fmt % args)
 
-    def _json(self, code: int, obj: dict):
+    def _json(self, code: int, obj: dict, headers: Optional[dict] = None):
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
     def _error(self, code: int, message: str,
                err_type: str = "invalid_request_error",
-               err_code: Optional[str] = None):
+               err_code: Optional[str] = None,
+               headers: Optional[dict] = None):
         self._json(code, {"error": {"message": message, "type": err_type,
-                                    "code": err_code if err_code else code}})
+                                    "code": err_code if err_code else code}},
+                   headers=headers)
+
+    def _overloaded(self, e: EngineOverloaded):
+        """429 + Retry-After: the structured load-shed answer. The router
+        treats this as a routable signal (another replica may have room);
+        clients back off by the hint."""
+        self._error(429, str(e), "overloaded_error",
+                    err_code=f"engine_overloaded:{e.reason}",
+                    headers={"Retry-After": str(int(e.retry_after_s + 0.5))})
 
     def _read_body(self) -> Optional[dict]:
         try:
@@ -207,6 +239,17 @@ class Handler(BaseHTTPRequestHandler):
                 "weights_dtype": eng.serving.weights_dtype,
                 "kv_dtype": eng.serving.kv_dtype,
                 "paged": bool(getattr(eng, "paged", False)),
+                # Robustness counters (r7): operators (and the chaos suite)
+                # read shed/deadline/stall/preemption totals here without a
+                # /metrics scrape+parse.
+                "shed_total": int(eng.metrics.requests_shed.total()),
+                "deadline_expired_total":
+                    int(eng.metrics.deadline_expired.total()),
+                "watchdog_stalls_total":
+                    int(eng.metrics.watchdog_stalls.total()),
+                "preemptions_total": int(eng.metrics.preemptions.total()),
+                "max_queue_depth": eng.serving.max_queue_depth or None,
+                "request_timeout_s": eng.serving.request_timeout_s or None,
             })
         elif path == "/load":
             # Tiny load snapshot for the gateway's ~1 Hz poller (router.py
@@ -345,6 +388,20 @@ class Handler(BaseHTTPRequestHandler):
         if min_tokens < 0:
             return self._error(400, "'min_tokens' must be >= 0")
         stream = bool(body.get("stream", False))
+        # End-to-end deadline (r7): relative milliseconds via the
+        # X-Request-Deadline-Ms header (router-forwarded) or the deadline_ms
+        # body field (body wins). The engine caps it at request_timeout_s,
+        # enforces it across queue wait + decode, and expiry answers 408.
+        raw_ddl = body.get(DEADLINE_FIELD, self.headers.get(DEADLINE_HEADER))
+        deadline_s = None
+        if raw_ddl is not None:
+            try:
+                deadline_s = float(raw_ddl) / 1000.0
+            except (TypeError, ValueError):
+                return self._error(400, f"'{DEADLINE_FIELD}' must be a "
+                                        "number of milliseconds")
+            if deadline_s <= 0:
+                return self._error(400, f"'{DEADLINE_FIELD}' must be > 0")
         # vLLM ``ignore_eos``: generate to the max_tokens budget regardless
         # of eos (bench/load harnesses depend on it for deterministic sizes)
         ignore_eos = bool(body.get("ignore_eos", False))
@@ -491,6 +548,7 @@ class Handler(BaseHTTPRequestHandler):
         # strips them again — lp_requested below).
         rank = best_of > n_choices
         eng_lp = lp_n if lp_n is not None else (0 if rank else None)
+        reqs = []
         try:
             # n/best_of: independent engine requests riding the same
             # continuous batch — the OpenAI semantics; identical for
@@ -500,18 +558,27 @@ class Handler(BaseHTTPRequestHandler):
             # Multi-choice streams share one wakeup event across the sibling
             # out_queues so the handler blocks instead of polling n queues.
             notify = threading.Event() if (stream and best_of > 1) else None
-            reqs = [st.engine.generate(
-                prompt_ids, max_tokens=max_tokens, temperature=temperature,
-                top_k=top_k, top_p=top_p, stream=stream, logprobs=eng_lp,
-                presence_penalty=presence_penalty,
-                frequency_penalty=frequency_penalty,
-                repetition_penalty=repetition_penalty,
-                stop_token_ids=stop_token_ids, min_tokens=min_tokens,
-                logit_bias=logit_bias, guided=guided, ignore_eos=ignore_eos,
-                lora=lora_name, prompt_logprobs=plp,
-                seed=None if seed is None else seed + i,
-                **({"out_queue": _NotifyQueue(notify)} if notify else {}))
-                for i in range(best_of)]
+            for i in range(best_of):
+                reqs.append(st.engine.generate(
+                    prompt_ids, max_tokens=max_tokens,
+                    temperature=temperature,
+                    top_k=top_k, top_p=top_p, stream=stream, logprobs=eng_lp,
+                    presence_penalty=presence_penalty,
+                    frequency_penalty=frequency_penalty,
+                    repetition_penalty=repetition_penalty,
+                    stop_token_ids=stop_token_ids, min_tokens=min_tokens,
+                    logit_bias=logit_bias, guided=guided,
+                    ignore_eos=ignore_eos,
+                    lora=lora_name, prompt_logprobs=plp,
+                    deadline_s=deadline_s,
+                    seed=None if seed is None else seed + i,
+                    **({"out_queue": _NotifyQueue(notify)} if notify else {})))
+        except EngineOverloaded as e:
+            # a later sibling can shed as the queue fills — don't strand the
+            # already-queued ones
+            for r in reqs:
+                st.engine.cancel(r)
+            return self._overloaded(e)
         except ContextLengthExceeded as e:
             # Same wire shape the reference's vLLM returns for an oversized
             # prompt (VERDICT r1: silent tail-truncation answered a different
@@ -551,11 +618,25 @@ class Handler(BaseHTTPRequestHandler):
         done = []
         completion_tokens = 0
         for req in reqs:
-            ids = req.wait(timeout=600)
-            if req.finish_reason == "error":
+            try:
+                ids = req.wait(timeout=_wait_budget_s(st.engine, req))
+            except TimeoutError:
+                # backstop only: the engine normally reaps the deadline
+                # itself and this wait returns with finish_reason "timeout"
+                for other in reqs:
+                    st.engine.cancel(other)
+                return self._error(408, "request timed out awaiting the "
+                                        "engine", "timeout",
+                                   err_code="deadline_exceeded")
+            if req.finish_reason in ("error", "timeout"):
                 for other in reqs:   # don't strand the sibling choices'
                     if other is not req:   # slots generating to max_tokens
                         st.engine.cancel(other)
+                if req.finish_reason == "timeout":
+                    return self._error(
+                        408, "request deadline exceeded before completion "
+                             "(slot and pages released)", "timeout",
+                        err_code="deadline_exceeded")
                 return self._error(500, "engine failure: "
                                    + (st.engine.last_error or "unknown"),
                                    "internal_error")
@@ -785,6 +866,14 @@ class Handler(BaseHTTPRequestHandler):
                 chunk(i, None, s["finish"])
             return True
 
+        # No-progress backstop (r7): the configured deadline default, not a
+        # hardcoded 600 — the engine reaps per-request deadlines and sends
+        # sentinels, so this only guards against a wedged engine loop.
+        # Config 0 = unbounded (capped at threading's wait ceiling, ~49
+        # days, because queue.get cannot take infinity).
+        stall_s = float(st.engine.serving.request_timeout_s or 0)
+        if stall_s <= 0:
+            stall_s = threading.TIMEOUT_MAX
         try:
             for i in range(len(states)):
                 if chat:
@@ -808,12 +897,13 @@ class Handler(BaseHTTPRequestHandler):
                         while s["finish"] is None and drain(i, 0.0):
                             progressed = True
                     else:
-                        progressed |= drain(i, 600.0)
+                        progressed |= drain(i, stall_s)
                 if progressed:
                     last_progress = time.monotonic()
                 elif multi:
-                    if time.monotonic() - last_progress > 600.0:
-                        raise TimeoutError("no stream progress in 600s")
+                    if time.monotonic() - last_progress > stall_s:
+                        raise TimeoutError(
+                            f"no stream progress in {stall_s:.0f}s")
                     ev = getattr(states[0]["req"].out_queue, "event", None)
                     if ev is not None:
                         # wait → clear → re-drain: a put racing the clear
@@ -826,8 +916,9 @@ class Handler(BaseHTTPRequestHandler):
                         # siblings submitted without the shared event (direct
                         # callers constructing their own reqs)
                         time.sleep(0.01)
-                elif time.monotonic() - last_progress > 600.0:
-                    raise TimeoutError("no stream progress in 600s")
+                elif time.monotonic() - last_progress > stall_s:
+                    raise TimeoutError(
+                        f"no stream progress in {stall_s:.0f}s")
             if include_usage:
                 n_gen = sum(len(s["req"].generated) for s in states)
                 raw_write(("data: " + json.dumps({
@@ -1073,6 +1164,16 @@ def main(argv=None):
                    metavar="NAME=PATH",
                    help="register a peft LoRA adapter dir, served as model "
                         "id NAME (repeatable; vLLM --enable-lora parity)")
+    p.add_argument("--request-timeout", type=float, default=600.0,
+                   help="default/maximum end-to-end deadline in seconds "
+                        "(per-request X-Request-Deadline-Ms / deadline_ms "
+                        "is capped by it; 0 disables)")
+    p.add_argument("--max-queue-depth", type=int, default=256,
+                   help="bounded engine queue: admissions past this depth "
+                        "are shed with 429 + Retry-After (0 = unbounded)")
+    p.add_argument("--admission-max-wait", type=float, default=0.0,
+                   help="shed admissions whose estimated queue wait "
+                        "(seconds) exceeds this (0 disables)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
@@ -1119,6 +1220,9 @@ def main(argv=None):
         spec_method=args.spec_method,
         draft_checkpoint_dir=args.draft_checkpoint_dir,
         lora_adapters=tuple(args.lora),
+        request_timeout_s=args.request_timeout,
+        max_queue_depth=args.max_queue_depth,
+        admission_max_wait_s=args.admission_max_wait,
         mesh=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep))
     state = build_state(serving)
     if not args.no_warmup:

@@ -54,6 +54,10 @@ python3 -m aws_k8s_ansible_provisioner_tpu.config \
   --set framework_image="$IMAGE" --set serving_replicas=1 \
   --set storage_class=standard --set serving_namespace="$NS" \
   > /tmp/serving-rehearsal.yaml
+# kubeconform (when installed) + built-in structural checks over the EXACT
+# bytes about to be applied (VERDICT next #8) — schema typos fail here, not
+# three rollout-timeouts later
+python3 deploy/validate_manifests.py /tmp/serving-rehearsal.yaml
 $KCTL apply -f /tmp/serving-rehearsal.yaml
 
 echo "==> waiting for engine + gateway"

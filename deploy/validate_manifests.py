@@ -1,0 +1,174 @@
+#!/usr/bin/env python3
+"""Validate rendered deploy/manifests output (ROADMAP / VERDICT next #8).
+
+Two layers, best available wins:
+
+1. **kubeconform** (when the binary is on PATH — workstations, the
+   rehearse-kind path): full upstream-schema validation, ``-strict`` so
+   unknown fields fail.
+2. **Built-in structural checks** (always, everywhere — this CI image ships
+   no kubeconform): YAML parses per-document; every doc carries
+   apiVersion/kind/metadata.name; Deployments' selectors match their pod
+   template labels; every probe port resolves to a declared containerPort
+   name/number; container images are non-empty; no unrendered ``{{``/``{%``
+   Jinja survives into the output. These are exactly the wiring-typo
+   classes a kind apply would reject — caught offline, in tier-1.
+
+Usage:
+    validate_manifests.py [rendered.yaml ...]
+With no args: renders every deploy/manifests/*.j2 through the repo's ONE
+render pipeline (config.render_manifest) — the serving manifest in both the
+production and rehearsal_cpu variants — and validates each.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class ManifestError(Exception):
+    pass
+
+
+def _fail(name: str, msg: str):
+    raise ManifestError(f"{name}: {msg}")
+
+
+def structural_validate(text: str, name: str = "<rendered>") -> int:
+    """Built-in checks; returns the number of documents validated."""
+    if "{{" in text or "{%" in text:
+        _fail(name, "unrendered Jinja delimiters survived into the output")
+    docs = [d for d in yaml.safe_load_all(text) if d]
+    if not docs:
+        _fail(name, "no YAML documents")
+    for d in docs:
+        if not isinstance(d, dict):
+            _fail(name, f"non-mapping document: {type(d).__name__}")
+        for key in ("apiVersion", "kind", "metadata"):
+            if key not in d:
+                _fail(name, f"document missing {key!r}: {d}")
+        meta = d["metadata"]
+        if not isinstance(meta, dict) or not meta.get("name"):
+            _fail(name, f"{d['kind']} missing metadata.name")
+        if d["kind"] in ("Deployment", "DaemonSet", "Job"):
+            _validate_workload(d, name)
+        if d["kind"] == "Service":
+            spec = d.get("spec") or {}
+            if not spec.get("ports"):
+                _fail(name, f"Service {meta['name']} declares no ports")
+    return len(docs)
+
+
+def _validate_workload(d: dict, name: str):
+    kind, mname = d["kind"], d["metadata"]["name"]
+    spec = d.get("spec") or {}
+    tmpl = (spec.get("template") or {})
+    labels = ((tmpl.get("metadata") or {}).get("labels")) or {}
+    if kind in ("Deployment", "DaemonSet"):
+        sel = ((spec.get("selector") or {}).get("matchLabels")) or {}
+        if not sel:
+            _fail(name, f"{kind} {mname} has no selector.matchLabels")
+        for k, v in sel.items():
+            if labels.get(k) != v:
+                _fail(name, f"{kind} {mname} selector {k}={v!r} does not "
+                            f"match template labels {labels}")
+    containers = ((tmpl.get("spec") or {}).get("containers")) or []
+    if not containers:
+        _fail(name, f"{kind} {mname} has no containers")
+    declared_volumes = {v.get("name")
+                       for v in ((tmpl.get("spec") or {}).get("volumes")
+                                 or [])}
+    for c in containers:
+        if not c.get("image"):
+            _fail(name, f"{kind} {mname} container {c.get('name')} has no "
+                        "image")
+        ports = {p.get("name"): p.get("containerPort")
+                 for p in (c.get("ports") or [])}
+        for probe in ("readinessProbe", "livenessProbe", "startupProbe"):
+            pr = (c.get(probe) or {}).get("httpGet")
+            if not pr:
+                continue
+            port = pr.get("port")
+            if isinstance(port, str) and port not in ports:
+                _fail(name, f"{kind} {mname} {probe} references port "
+                            f"{port!r} not declared on the container")
+        for vm in c.get("volumeMounts") or []:
+            if vm.get("name") not in declared_volumes:
+                _fail(name, f"{kind} {mname} container {c.get('name')} "
+                            f"mounts undeclared volume {vm.get('name')!r}")
+
+
+def kubeconform_validate(text: str, name: str) -> bool:
+    """Run kubeconform when available. Returns False when the binary is
+    absent (caller falls back to structural checks only)."""
+    exe = shutil.which("kubeconform")
+    if not exe:
+        return False
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                     delete=False) as f:
+        f.write(text)
+        path = f.name
+    try:
+        p = subprocess.run(
+            [exe, "-strict", "-summary",
+             "-ignore-missing-schemas",   # Gateway/CRDs have no upstream schema
+             path], capture_output=True, text=True)
+        if p.returncode != 0:
+            _fail(name, f"kubeconform: {p.stdout} {p.stderr}")
+    finally:
+        os.unlink(path)
+    return True
+
+
+def _render_all() -> list:
+    sys.path.insert(0, REPO)
+    from aws_k8s_ansible_provisioner_tpu.config import render_manifest
+
+    mdir = os.path.join(REPO, "deploy", "manifests")
+    out = []
+    for fn in sorted(os.listdir(mdir)):
+        if not fn.endswith(".j2"):
+            continue
+        path = os.path.join(mdir, fn)
+        out.append((fn + "[production]", render_manifest(path)))
+        if fn.startswith("serving"):
+            out.append((fn + "[rehearsal_cpu]",
+                        render_manifest(path, rehearsal_cpu=True,
+                                        model="tiny-qwen3",
+                                        framework_image="img:rehearsal",
+                                        storage_class="standard")))
+    return out
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv:
+        targets = [(os.path.basename(p), open(p).read()) for p in argv]
+    else:
+        targets = _render_all()
+    used_kubeconform = False
+    n_docs = 0
+    try:
+        for name, text in targets:
+            n_docs += structural_validate(text, name)
+            used_kubeconform |= kubeconform_validate(text, name)
+    except ManifestError as e:
+        print(f"MANIFEST INVALID: {e}", file=sys.stderr)
+        return 1
+    mode = "kubeconform + structural" if used_kubeconform else \
+        "structural (kubeconform not on PATH)"
+    print(f"manifests valid: {len(targets)} render(s), {n_docs} documents "
+          f"[{mode}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

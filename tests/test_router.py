@@ -402,8 +402,9 @@ def test_chat_affinity_is_conversation_identity():
 
 
 def test_poller_skips_cooling_replicas():
-    """A cooled-down replica must not be polled (a few blackholed IPs would
-    otherwise stretch the cycle past LOAD_TTL_S and stale every sample)."""
+    """A cooled-down replica gets only the cheap /healthz recovery probe,
+    never a /load sample (a blackholed IP must not contribute stale load;
+    the bounded concurrent poll keeps the cycle under LOAD_TTL_S)."""
     import time as _t
 
     from aws_k8s_ansible_provisioner_tpu.serving.router import (
@@ -424,6 +425,55 @@ def test_poller_skips_cooling_replicas():
             _t.sleep(0.05)
         assert live in pool._load
         assert dead not in pool._load
+        # the unreachable replica never recovers (its probe can't answer)
+        assert dead in pool.cooling()
+    finally:
+        stop.set()
+        srv.shutdown()
+
+
+class HealthyEngine(LoadReportingEngine):
+    """Fake backend with a /healthz whose status the test controls."""
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            code = getattr(self.server, "health_status", 200)
+            self._send(code, {"status": "ok" if code == 200 else "stalled"})
+        else:
+            LoadReportingEngine.do_GET(self)
+
+
+def test_recovered_replica_reenters_rotation_within_cooldown():
+    """Regression (ISSUE r7 satellite): a replica that answers /healthz
+    again must re-enter rotation within ONE poll interval — not serve out
+    its whole cooldown window — while a 503-stalled replica stays out."""
+    import time as _t
+
+    from aws_k8s_ansible_provisioner_tpu.serving.router import (
+        RouterMetrics, start_load_poller)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), HealthyEngine)
+    srv.fake_active = 0
+    srv.health_status = 503        # starts wedged: probe must NOT recover it
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    addr = f"127.0.0.1:{srv.server_port}"
+    pool = BackendPool(addr, cooldown_s=3600)   # cooldown >> the test
+    metrics = RouterMetrics()
+    pool.mark_dead(addr)
+    assert addr in pool.cooling()
+    stop = threading.Event()
+    start_load_poller(pool, interval_s=0.05, stop=stop, metrics=metrics)
+    try:
+        _t.sleep(0.5)
+        assert addr in pool.cooling(), "503-stalled replica recovered early"
+        srv.health_status = 200                  # replica comes back
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline and addr in pool.cooling():
+            _t.sleep(0.05)
+        assert addr not in pool.cooling(), \
+            "healthy replica did not re-enter rotation within the window"
+        assert pool.pick()[0] == addr            # routable again
+        assert metrics.recovered.total() >= 1
     finally:
         stop.set()
         srv.shutdown()
