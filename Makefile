@@ -4,7 +4,8 @@
 
 PY ?= python
 
-.PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests
+.PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests \
+	overload-smoke
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -28,6 +29,15 @@ bench-smoke:
 chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -q \
 		-p no:cacheprovider
+
+# Overload BENCH on CPU (ROADMAP robustness follow-on): offered load through
+# the REAL router past the replicas' admission limits; writes the
+# shed-rate-vs-offered-load curve to OVERLOAD_BENCH.json. Expected shape:
+# ~0 shed while offered <= capacity, rising shed rate with completed
+# throughput holding — overload degrades by policy, not collapse.
+overload-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench_sweep.py --overload \
+		--overload-requests 24 --overload-levels 1,4,16
 
 # kubeconform (when installed) + structural validation over every rendered
 # deploy/manifests template; rehearse-kind.sh runs the same validator on the

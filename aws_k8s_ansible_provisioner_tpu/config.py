@@ -690,6 +690,14 @@ class ServingConfig:
     # this is shed with 429 even below max_queue_depth — the queue never
     # holds work that would blow its deadline anyway. 0 disables.
     admission_max_wait_s: float = 0.0
+    # Graceful drain budget (r8): on SIGTERM / POST /admin/drain the engine
+    # stops admitting (new requests shed with the routable "draining"
+    # reason, 503 at the HTTP layer), /readyz flips to 503, and in-flight
+    # requests get this many seconds to finish; stragglers are then
+    # cancelled through the deadline path (finish "timeout", slot/pages
+    # released exactly once) and the process exits 0. serving.yaml.j2
+    # derives terminationGracePeriodSeconds from the same knob.
+    drain_timeout_s: float = 30.0
     # Stall watchdog: a decode step executing past this is declared stalled —
     # /healthz flips to 503 and the watchdog thread arms the abort flag that
     # fails the affected requests instead of the process (host-observable
@@ -839,6 +847,9 @@ def ansible_vars(cfg: FrameworkConfig | None = None) -> str:
     # single source.
     d["serving_request_timeout_s"] = cfg.serving.request_timeout_s
     d["serving_max_queue_depth"] = cfg.serving.max_queue_depth
+    # Replica lifecycle (r8): the preStop hook, terminationGracePeriodSeconds
+    # and the engine's --drain-timeout all derive from this one knob.
+    d["serving_drain_timeout_s"] = cfg.serving.drain_timeout_s
     lines = ["# generated by aws_k8s_ansible_provisioner_tpu.config — do not edit"]
     for k, v in d.items():
         lines.append(f"{k}: {json.dumps(v)}")

@@ -26,9 +26,24 @@ fault                       defined degradation behavior
                             sibling requests keep full throughput
 ``mid_stream_disconnect``   server cancels the engine request; the slot and
                             its pages release exactly once
+``kill_stream``             the REPLICA dies mid-stream from its peer's
+                            point of view: after ``after_chunks`` relayed
+                            content chunks the server hard-RSTs the
+                            connection and cancels the engine request —
+                            the router fails the stream over to another
+                            replica as a deterministic continuation
+                            (resume_token_ids), splicing only new chunks
+``stream_read_error``       router-side fault point: the SSE relay's read
+                            from the backend raises after ``after_events``
+                            relayed events — drives the failover path
+                            without any server cooperation
 ``deadline``                (engine-native, no injection needed) request
                             past its deadline is cancelled, slot/pages
                             released, client gets 408 deadline_exceeded
+``drain``                   (engine-native, no injection needed) SIGTERM /
+                            /admin/drain sheds new admissions (503
+                            "draining", router re-routes), finishes
+                            in-flight work, exits 0 within drain_timeout_s
 ==========================  ==============================================
 
 Server-side faults are *injected* through hook points in engine.py /
@@ -54,7 +69,8 @@ import time
 from typing import Dict, Optional
 
 FAULTS = ("connect_refused", "stalled_decode", "page_exhaustion",
-          "slow_client", "mid_stream_disconnect")
+          "slow_client", "mid_stream_disconnect", "kill_stream",
+          "stream_read_error")
 
 
 class InjectedFault(RuntimeError):
@@ -196,6 +212,64 @@ class ChaosController:
         raise ConnectionRefusedError(f"chaos: injected connect refusal "
                                      f"for backend {addr}")
 
+    def on_stream_chunk(self, handler, n_chunks: int) -> None:
+        """server _stream_response, after each relayed content chunk: an
+        armed ``kill_stream`` hard-closes (SO_LINGER-0 RST) the client
+        connection once the stream has emitted ``after_chunks`` chunks —
+        the replica "dies" mid-stream from its peer's (the router's) point
+        of view — then raises InjectedFault so the stream handler unwinds
+        and cancels the engine request exactly like a real broken pipe.
+        Per-STREAM chunk counting is the caller's (``n_chunks``); the
+        controller's deterministic times/after budget decides which streams
+        die."""
+        p = self.active("kill_stream")
+        if p is None or n_chunks < int(p.get("after_chunks", 1)):
+            return
+        if self.fire("kill_stream") is None:
+            return
+        import struct as _struct
+        # RST, not FIN: a clean close is how SSE legitimately ENDS — a
+        # crashed replica resets. The makefile objects hold fd refs, so
+        # close them FIRST (idempotently re-closed by the handler's own
+        # finish()), then the socket close actually sends the RST.
+        handler.close_connection = True
+        try:
+            handler.connection.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER,
+                _struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+        for f in (handler.wfile, handler.rfile, handler.connection):
+            try:
+                f.close()
+            except OSError:
+                pass
+        # the http.server plumbing still flushes/closes wfile/rfile after
+        # the handler unwinds — hand it harmless sinks, not the dead socket
+        import io as _io
+        handler.wfile = _io.BytesIO()
+        handler.rfile = _io.BytesIO(b"")
+        raise InjectedFault(f"chaos: replica killed mid-stream after "
+                            f"{n_chunks} chunks")
+
+    def check_stream_read(self, addr: str, n_events: int) -> None:
+        """router SSE relay, before each backend read: an armed
+        ``stream_read_error`` raises the ConnectionResetError a dying
+        backend socket produces once ``after_events`` events were relayed —
+        the failover path is drivable without any server cooperation.
+        ``addr_prefix`` restricts it to matching backends."""
+        p = self.active("stream_read_error")
+        if p is None or n_events < int(p.get("after_events", 1)):
+            return
+        p = self.fire("stream_read_error")
+        if p is None:
+            return
+        prefix = str(p.get("addr_prefix", ""))
+        if prefix and not addr.startswith(prefix):
+            return
+        raise ConnectionResetError(f"chaos: injected mid-stream read "
+                                   f"failure from backend {addr}")
+
 
 _controller: Optional[ChaosController] = None
 _controller_lock = threading.Lock()
@@ -217,6 +291,17 @@ def reset() -> ChaosController:
     with _controller_lock:
         _controller = None
     return get()
+
+
+def kill_replica_after_chunks(k: int, times: int = 1, after: int = 0):
+    """Arm the replica-kill-mid-stream scenario (ROADMAP robustness
+    follow-on): the next ``times`` streams to emit ``k`` content chunks die
+    with an RST at that point (server-side ``kill_stream`` fault). Under a
+    router this drives the mid-stream failover path: the router re-issues
+    the request to another replica as a deterministic continuation and
+    splices only new chunks — tests/test_router_e2e.py asserts the client
+    stream stays byte-identical to an undisturbed run."""
+    get().inject("kill_stream", after=after, times=times, after_chunks=k)
 
 
 # ---------------------------------------------------------------------------

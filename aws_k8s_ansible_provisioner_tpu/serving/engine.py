@@ -164,6 +164,16 @@ class Request:
     # between dispatches — expiry cancels the request, releases its slot and
     # pages, and finishes it with finish_reason "timeout" (HTTP 408).
     deadline_s: Optional[float] = None
+    # Mid-stream failover continuation (r8): token ids another replica
+    # already generated (and relayed to the client) for this exact prompt +
+    # sampling params + seed. submit() pre-populates ``generated`` with them
+    # and registers a preemption-style resume, so the request re-prefills
+    # prompt + resume as pure CACHE REBUILD and the next decode draw uses
+    # the seeded key at position len(prompt) + len(resume) — by the
+    # cross-resume reproducibility contract (decode_steps' ctr alignment),
+    # the continuation is token-identical to the uninterrupted stream.
+    # Only the NEW tokens reach out_queue. Paged engines only.
+    resume_ids: tuple = ()
     # absolute time.monotonic() deadline, resolved at submit (0.0 = none)
     t_deadline: float = 0.0
     id: int = field(default_factory=lambda: next(_REQUEST_IDS))
@@ -1089,6 +1099,13 @@ class Engine:
             self.STALL_AFTER_S = float(serving.watchdog_stall_s)
         self._stall_abort = False
         self._admission_blocked_since = 0.0
+        # Graceful drain (r8): while draining, submit() sheds everything with
+        # the structured "draining" reason (503 at the HTTP layer — the
+        # router re-routes it like a connect failure); in-flight requests run
+        # to completion until _drain_deadline, past which _reap_expired
+        # cancels stragglers through the existing deadline path.
+        self.draining = False
+        self._drain_deadline = 0.0
 
     # -- decode batch-block autotune ----------------------------------------
 
@@ -1469,6 +1486,15 @@ class Engine:
 
     def submit(self, req: Request) -> Request:
         req.t_submit = time.monotonic()
+        # Graceful drain (r8): a draining engine admits NOTHING — shed with
+        # the structured "draining" reason before any other validation.
+        # Nothing was generated, so the caller (router) may always re-route.
+        if self.draining:
+            self.metrics.requests_shed.inc(reason="draining")
+            raise EngineOverloaded(
+                "draining", "engine is draining; not admitting new requests",
+                retry_after_s=max(1.0, self._drain_deadline
+                                  - time.monotonic()))
         # A prompt that doesn't fit is an ERROR, not a truncation: serving the
         # tail of a too-long prompt silently answers a different question
         # (the reference's vLLM rejects with 400 context_length_exceeded).
@@ -1476,6 +1502,19 @@ class Engine:
         if len(req.prompt_ids) > self.prompt_limit:
             raise ContextLengthExceeded(len(req.prompt_ids), self.prompt_limit,
                                         self.max_len)
+        if req.resume_ids:
+            # Failover continuation: rides the preemption-resume machinery,
+            # which is paged-only (_paged_admit consults _resume_ctx).
+            if not self.paged:
+                raise ValueError("continuation (resume_ids) requires the "
+                                 "paged engine")
+            if len(req.prompt_ids) + len(req.resume_ids) > self.max_len - 2:
+                raise ContextLengthExceeded(
+                    len(req.prompt_ids) + len(req.resume_ids),
+                    self.max_len - 2, self.max_len)
+            if req.prompt_logprobs is not None:
+                raise ValueError("continuation cannot carry prompt_logprobs "
+                                 "(computed at first prefill only)")
         if req.min_tokens > 0:
             n_ban = len(self._ban_set(req))
             if n_ban > BAN_K:
@@ -1558,16 +1597,39 @@ class Engine:
                     "est_wait",
                     f"estimated queue wait {est:.1f}s exceeds the "
                     f"admission limit {mw:.1f}s", retry_after_s=est - mw + 1)
+        ctx_len = len(req.prompt_ids)
+        if req.resume_ids:
+            # Continuation admission: pre-populate ``generated`` with the
+            # already-relayed tokens and register a preemption-style resume —
+            # _paged_admit sees the ctx and the chunk walk re-prefills
+            # prompt + resume as a cache rebuild (_activate(resumed=True)
+            # discards the prefill draw; the next decode draw's seeded key
+            # lands at the exact position the dead replica would have used).
+            # All of this is installed BEFORE sched.submit publishes the id:
+            # the engine thread may admit the instant it does.
+            req.generated = list(req.resume_ids)
+            if req.guided is not None:
+                # the FSM must stand where the dead replica's stood: past
+                # every already-emitted token
+                for t in req.resume_ids:
+                    req.guided.advance(int(t))
+            ctx = list(req.prompt_ids) + list(req.resume_ids)
+            ctx_len = len(ctx)
+            self._resume_ctx[req.id] = ctx
         with self._lock:
             self._queued[req.id] = req
-            ok = self.sched.submit(req.id, len(req.prompt_ids),
-                                   req.max_tokens)
+            # paged admission gates on the FULL context a resume re-prefills
+            ok = self.sched.submit(req.id, ctx_len,
+                                   max(1, req.max_tokens
+                                       - len(req.resume_ids)))
             if not ok:
                 # bounded queue (scheduler-enforced so the native core and
                 # Python fallback shed identically under racing submitters)
                 del self._queued[req.id]
             self.metrics.queue_depth.set(self.sched.stats().queue_depth)
         if not ok:
+            if req.resume_ids:
+                self._resume_ctx.pop(req.id, None)
             self.metrics.requests_shed.inc(reason="queue_full")
             raise EngineOverloaded(
                 "queue_full",
@@ -1691,21 +1753,60 @@ class Engine:
         self.sched.cancel(req.id)
         self._work_event.set()
 
+    # -- graceful drain (r8) -------------------------------------------------
+
+    def begin_drain(self, timeout_s: Optional[float] = None) -> float:
+        """Stop admitting (submit sheds with reason "draining") and give
+        in-flight requests until ``timeout_s`` (default
+        serving.drain_timeout_s) to finish; past that, _reap_expired cancels
+        stragglers through the existing deadline path — slot/pages released
+        exactly once, streams finish "timeout". Idempotent: a second call
+        while draining keeps the FIRST deadline (preStop + SIGTERM both
+        trigger it). Returns seconds until the drain deadline."""
+        now = time.monotonic()
+        if self.draining:
+            return max(0.0, self._drain_deadline - now)
+        t = float(self.serving.drain_timeout_s
+                  if timeout_s is None else timeout_s)
+        t = max(0.0, t)
+        self.draining = True
+        self._drain_deadline = now + t
+        self.metrics.draining.set(1)
+        self._work_event.set()
+        return t
+
+    def end_drain(self):
+        """Cancel a drain: admissions resume (operator abort / rollback)."""
+        self.draining = False
+        self._drain_deadline = 0.0
+        self.metrics.draining.set(0)
+        self._work_event.set()
+
+    def _effective_deadline(self, req: Request) -> float:
+        """The request's own deadline tightened by the drain deadline
+        (0.0 = none): drain stragglers expire through the SAME path as any
+        deadline — one cancel site, exactly-once accounting."""
+        d = req.t_deadline or 0.0
+        if self.draining and self._drain_deadline:
+            d = min(d or self._drain_deadline, self._drain_deadline)
+        return d
+
     def _reap_expired(self):
         """Cancel every request whose end-to-end deadline has passed:
         running slots finish with "timeout" (slot + pages released through
         the one _finish path — exactly-once), the in-flight chunk walk is
         torn down, and queued requests are notified immediately instead of
-        waiting to surface through admission."""
+        waiting to surface through admission. The drain deadline
+        (begin_drain) tightens every deadline through the same path."""
         now = time.monotonic()
         for slot, r in enumerate(self.slot_req):
-            if r is not None and r.t_deadline and now >= r.t_deadline:
+            if r is not None and 0 < self._effective_deadline(r) <= now:
                 r.finish_reason = "timeout"
                 self.metrics.deadline_expired.inc()
                 self._finish(slot)
         st = self._chunk
-        if st is not None and st["req"].t_deadline \
-                and now >= st["req"].t_deadline:
+        if st is not None \
+                and 0 < self._effective_deadline(st["req"]) <= now:
             self._chunk = None
             req, slot = st["req"], st["slot"]
             self._release_slot_pages(slot)
@@ -1717,7 +1818,7 @@ class Engine:
         expired = []
         with self._lock:
             for rid, r in list(self._queued.items()):
-                if r.t_deadline and now >= r.t_deadline:
+                if 0 < self._effective_deadline(r) <= now:
                     expired.append(r)
                     del self._queued[rid]
         for r in expired:

@@ -220,6 +220,13 @@ class EngineMetrics:
             "tpu_serve_admission_preemptions_total",
             "Lowest-progress requests preempted to unwedge page-starved "
             "admission"))
+        # Replica lifecycle (r8): 1 while the engine is draining (rejecting
+        # new admissions, finishing in-flight work) — the readiness signal
+        # /readyz and the router's /load poller key off the same state.
+        self.draining = r.register(Gauge(
+            "tpu_serve_draining",
+            "1 while the engine is draining (new admissions shed with "
+            "reason=draining)"))
 
     def mark_request(self, status: str, duration_s: float):
         self.request_total.inc(status=status)

@@ -101,12 +101,31 @@ class RouterMetrics:
             "tpu_router_backend_recovered_total",
             "Cooling-down backends returned to rotation early after "
             "answering the health probe"))
+        # Replica lifecycle (r8): mid-stream failover + drain-aware routing.
+        self.stream_failovers = r.register(Counter(
+            "tpu_router_stream_failovers_total",
+            "Streams continued on another replica after a replica died "
+            "mid-stream (deterministic continuation; only new chunks "
+            "spliced to the client)"))
+        self.draining_skips = r.register(Counter(
+            "tpu_router_backend_draining_total",
+            "Requests re-routed off a draining replica (503 draining "
+            "shed at admission — nothing generated, always re-routable)"))
 
 
 # A /load sample older than this no longer orders candidates (a replica that
 # stopped answering its poller is either dead — the connect path will find
 # out — or wedged; either way its last-known load is fiction).
 LOAD_TTL_S = 5.0
+# A replica reporting ``draining`` on /load is out of rotation WITHOUT being
+# dead-marked (it is healthy, it is leaving). Entries refresh every poll;
+# the TTL returns a replica whose poller went silent (restart completing)
+# to normal connect-phase discovery instead of excluding it forever.
+DRAIN_TTL_S = 10.0
+# Mid-stream failovers per request: each continuation re-prefills the
+# emitted prefix on another replica, so the budget bounds the worst-case
+# extra prefill work a flapping fleet can induce per stream.
+STREAM_FAILOVER_BUDGET = 2
 # Affinity yields when the sticky replica's in-flight+queued exceeds the
 # least-loaded replica's by more than this (prefix reuse saves prefill; it
 # never justifies queueing behind a pile while a sibling idles).
@@ -150,6 +169,8 @@ class BackendPool:
         self._addrs: list[str] = list(self._static)
         self._rr = itertools.count()
         self._dead: dict[str, float] = {}
+        # addr -> time last seen draining (poller-fed; TTL'd in pick())
+        self._draining: dict[str, float] = {}
         self._last_refresh = 0.0
         # addr -> (active + queued, t_sampled); written by the ~1 Hz poller
         self._load: dict[str, tuple[int, float]] = {}
@@ -215,8 +236,15 @@ class BackendPool:
                 self._last_refresh = now
             self._dead = {a: t for a, t in self._dead.items()
                           if now - t < self.cooldown_s}
-            alive = [a for a in self._addrs if a not in self._dead]
-            pool = alive or self._addrs  # all dead → try everything anyway
+            self._draining = {a: t for a, t in self._draining.items()
+                              if now - t < DRAIN_TTL_S}
+            alive = [a for a in self._addrs
+                     if a not in self._dead and a not in self._draining]
+            # all draining → fall back to the draining set (they shed 503
+            # and the request-path handles it); all dead → try everything
+            pool = alive \
+                or [a for a in self._addrs if a not in self._dead] \
+                or self._addrs
             if not pool:
                 return []
             k = next(self._rr) % len(pool)
@@ -246,6 +274,28 @@ class BackendPool:
         with self._lock:
             self._dead[addr] = time.monotonic()
             self._load.pop(addr, None)
+
+    def note_draining(self, addr: str) -> bool:
+        """A replica reported ``draining``: remove it from rotation WITHOUT
+        dead-marking (no cooldown to serve out — it re-enters within one
+        poll of draining going false). Returns whether this is a
+        transition (was in rotation)."""
+        with self._lock:
+            fresh = addr not in self._draining
+            self._draining[addr] = time.monotonic()
+            return fresh
+
+    def clear_draining(self, addr: str) -> bool:
+        """The replica stopped draining (restart finished / drain
+        cancelled): back into rotation NOW."""
+        with self._lock:
+            return self._draining.pop(addr, None) is not None
+
+    def draining(self) -> list[str]:
+        now = time.monotonic()
+        with self._lock:
+            return sorted(a for a, t in self._draining.items()
+                          if now - t < DRAIN_TTL_S)
 
     def note_recovered(self, addr: str) -> bool:
         """A cooling-down replica answered its health probe: return it to
@@ -338,6 +388,17 @@ def start_load_poller(pool: BackendPool, interval_s: float = 1.0,
             if resp.status == 200:
                 d = json.loads(resp.read())
                 if isinstance(d, dict):
+                    # drain recognition (r8): a draining replica leaves
+                    # rotation WITHOUT dead-marking and re-enters within
+                    # one poll of draining going false (drain cancelled,
+                    # or the drained pod restarted)
+                    if d.get("draining"):
+                        if pool.note_draining(addr):
+                            log.info("backend %s draining; out of rotation",
+                                     addr)
+                    elif pool.clear_draining(addr):
+                        log.info("backend %s done draining; back in "
+                                 "rotation", addr)
                     pool.note_load(addr, d.get("active", 0) or 0,
                                    d.get("queued", 0) or 0)
         except Exception:
@@ -379,6 +440,79 @@ def start_load_poller(pool: BackendPool, interval_s: float = 1.0,
     return t
 
 
+def _failover_spec(path: str, body: bytes | None):
+    """The parsed request body when this request is eligible for mid-stream
+    failover, else None.
+
+    Eligible = a single-choice streaming completion the backend tags with
+    per-chunk ``token_ids``: the router can then re-issue a dying stream to
+    another replica as a deterministic continuation (resume_token_ids +
+    resume_text_chars) and splice only new chunks. Multi-choice (n/best_of),
+    echo, and requests that are already continuations stay on the
+    truncate-on-death path."""
+    if body is None or not path.startswith(("/v1/completions",
+                                           "/v1/chat/completions")):
+        return None
+    try:
+        obj = json.loads(body)
+    except ValueError:
+        return None
+    if not isinstance(obj, dict) or not obj.get("stream"):
+        return None
+    if obj.get("n", 1) != 1 or obj.get("best_of", 1) != 1:
+        return None
+    if obj.get("echo") or obj.get("resume_token_ids") is not None:
+        return None
+    return obj
+
+
+def _track_sse_event(event: bytes, st: dict):
+    """Account one relayed SSE event into the failover state: generated
+    token ids covered, generated-text chars the client now has, [DONE]."""
+    if not event.startswith(b"data: "):
+        return
+    payload = event[len(b"data: "):].strip()
+    if payload == b"[DONE]":
+        st["done"] = True
+        return
+    try:
+        obj = json.loads(payload)
+    except ValueError:
+        return
+    if not isinstance(obj, dict):
+        return
+    for c in obj.get("choices") or []:
+        if not isinstance(c, dict):
+            continue
+        if "token_ids" in c:
+            # the backend speaks the failover dialect: relayed text is
+            # fully accounted by relayed token ids, so continuation is safe
+            st["tagged"] = True
+            st["token_ids"].extend(int(t) for t in c.get("token_ids") or [])
+        txt = c.get("text")
+        if txt is None:
+            txt = (c.get("delta") or {}).get("content")
+        if isinstance(txt, str):
+            st["chars"] += len(txt)
+
+
+def _continuation_body(fo: dict, st: dict) -> bytes:
+    """The continuation request for a stream that died after relaying
+    ``st``: original body + resume fields, max_tokens decremented to the
+    REMAINING budget (the backend adds the resume length back — a body
+    without max_tokens keeps the server default as the total budget)."""
+    obj = dict(fo)
+    obj["resume_token_ids"] = list(st["token_ids"])
+    obj["resume_text_chars"] = int(st["chars"])
+    if "max_tokens" in fo:
+        try:
+            obj["max_tokens"] = max(0, int(fo["max_tokens"])
+                                    - len(st["token_ids"]))
+        except (TypeError, ValueError):
+            pass
+    return json.dumps(obj).encode()
+
+
 class RouterHandler(BaseHTTPRequestHandler):
     pool: BackendPool = None       # injected by serve()
     metrics: RouterMetrics = None  # injected by serve()
@@ -408,12 +542,15 @@ class RouterHandler(BaseHTTPRequestHandler):
                 # cooling down forever (review r4)
                 dead = sorted(a for a, t in self.pool._dead.items()
                               if now - t < self.pool.cooldown_s)
+                draining = sorted(a for a, t in self.pool._draining.items()
+                                  if now - t < DRAIN_TTL_S)
             self._respond_json(200, {"status": "ok",
                                      "backends": self.pool._addrs,
                                      # fresh per-replica active+queued from
                                      # the /load poller; absent = unknown
                                      "backend_load": loads,
-                                     "cooling_down": dead})
+                                     "cooling_down": dead,
+                                     "draining": draining})
             return
         if self.path == "/metrics":
             # The router's OWN counters (not proxied): the engine pods are
@@ -444,25 +581,60 @@ class RouterHandler(BaseHTTPRequestHandler):
                 for h in ("Content-Type", "Authorization", "Accept",
                           DEADLINE_HEADER)
                 if self.headers.get(h)}
-        # A declared end-to-end deadline bounds THIS hop's read timeout too:
-        # the backend enforces the deadline (408 within it), so waiting the
-        # full READ_TIMEOUT_S past it only pins a router thread on a wedged
-        # replica.
-        read_to = READ_TIMEOUT_S
+        # End-to-end deadline, parsed ONCE: every re-dispatch (429 backoff,
+        # connect failover, mid-stream continuation) forwards only the
+        # REMAINING budget — sleeps and failed attempts eat real wall-clock
+        # the backend's enforcement must count (r8 satellite; previously the
+        # header was forwarded verbatim, so a second hop saw a fresh
+        # deadline). The same remainder bounds this hop's read timeout. A
+        # malformed header is forwarded verbatim; the backend answers 400.
+        t_start = time.monotonic()
+        ddl_ms = None
         raw_ddl = self.headers.get(DEADLINE_HEADER)
         if raw_ddl:
             try:
-                read_to = min(READ_TIMEOUT_S,
-                              max(1.0, float(raw_ddl) / 1000.0)
-                              + READ_TIMEOUT_GRACE_S)
+                ddl_ms = float(raw_ddl)
             except ValueError:
                 pass    # backend rejects the malformed header with a 400
+        # Mid-stream failover (r8): for an eligible stream, every relayed
+        # SSE event is accounted (token ids / text chars / [DONE]) so a
+        # replica death mid-stream re-enters this loop as a CONTINUATION —
+        # original body + resume fields — and only new chunks reach the
+        # client. ``headers_sent`` guards every would-send-status path.
+        fo = _failover_spec(path, body) if method == "POST" else None
+        fo_state = {"token_ids": [], "chars": 0, "done": False,
+                    "tagged": False, "headers_sent": False, "failovers": 0}
+        cur_body = body
         last_err = None
         shed = None          # last 429 body, relayed if every retry sheds
+        drained = None       # last draining-503 body, relayed if all drain
         n_429 = 0
         for i, addr in enumerate(candidates):
-            if i > 0:
+            if i > 0 and not fo_state["headers_sent"]:
                 self.metrics.failovers.inc()
+            hdrs2 = dict(hdrs)
+            read_to = READ_TIMEOUT_S
+            if ddl_ms is not None:
+                rem_ms = ddl_ms - (time.monotonic() - t_start) * 1000.0
+                if rem_ms <= 0:
+                    # deadline burnt inside the gateway: answering now beats
+                    # dispatching work the backend must immediately expire
+                    if fo_state["headers_sent"]:
+                        self.close_connection = True
+                        return
+                    self.metrics.requests.inc(code="408")
+                    self._respond_json(408, {"error": {
+                        "message": "request deadline exhausted during "
+                                   "gateway retries",
+                        "type": "timeout", "code": "deadline_exceeded"}})
+                    return
+                hdrs2[DEADLINE_HEADER] = str(int(max(1.0, rem_ms)))
+                # the remaining deadline bounds this hop's read timeout too:
+                # the backend answers 408 within it, so waiting the full
+                # READ_TIMEOUT_S past it only pins a router thread
+                read_to = min(READ_TIMEOUT_S,
+                              max(1.0, rem_ms / 1000.0)
+                              + READ_TIMEOUT_GRACE_S)
             # Phase 1: CONNECT, with its own short timeout. Connect-level
             # failures (refused, unreachable, DNS) are always safe to retry on
             # the next replica — the request never reached a server, so even a
@@ -483,29 +655,60 @@ class RouterHandler(BaseHTTPRequestHandler):
                 log.warning("backend %s connect failed (%s); trying next",
                             addr, e)
                 continue
-            # Phase 2: send + await response under the long read timeout. The
-            # backend HAS the request now; a timeout here may mean it is still
-            # generating. Requests with a body are NOT retried past this point
-            # (a retry would duplicate the generation on a second replica);
-            # bodyless GETs are idempotent and may fail over.
+            # Phase 2: send + await response under the deadline-bounded read
+            # timeout. The backend HAS the request now; a timeout here may
+            # mean it is still generating. Requests with a body are NOT
+            # retried past this point (a retry would duplicate the
+            # generation on a second replica) — EXCEPT failover-eligible
+            # streams, which re-issue as a continuation: whatever the dead
+            # replica generated but didn't relay is re-derived
+            # deterministically, and the client never sees a byte twice.
             try:
                 conn.sock.settimeout(read_to)
-                conn.request(method, self.path, body=body, headers=hdrs)
+                conn.request(method, self.path, body=cur_body, headers=hdrs2)
                 resp = conn.getresponse()
             except OSError as e:
                 conn.close()
                 self.pool.mark_dead(addr)
                 self.metrics.dead_marks.inc()
                 last_err = e
-                if body is not None:
+                if cur_body is not None:
+                    if fo is not None \
+                            and fo_state["failovers"] < STREAM_FAILOVER_BUDGET \
+                            and (fo_state["tagged"]
+                                 or fo_state["chars"] == 0):
+                        fo_state["failovers"] += 1
+                        self.metrics.stream_failovers.inc()
+                        cur_body = _continuation_body(fo, fo_state)
+                        log.warning("backend %s died pre-response (%s); "
+                                    "re-issuing stream as continuation "
+                                    "(%d tokens relayed)", addr, e,
+                                    len(fo_state["token_ids"]))
+                        continue
                     log.warning("backend %s failed after accepting a request "
                                 "body (%s); NOT retrying elsewhere", addr, e)
+                    if fo_state["headers_sent"]:
+                        self.close_connection = True
+                        return
                     self.metrics.requests.inc(code="502")
                     self._respond_json(502, {"error": {
                         "message": f"backend failed mid-request: {e}",
                         "type": "router_error"}})
                     return
                 log.warning("backend %s failed (%s); trying next", addr, e)
+                continue
+            # Phase 2.4: 503 + X-TPU-Draining = the replica shed at
+            # admission because it is LEAVING (SIGTERM / preStop drain) —
+            # nothing was generated, so re-routing is always safe, and the
+            # replica is NOT dead-marked (no cooldown to serve out; the
+            # poller excludes it until it stops draining).
+            if resp.status == 503 and resp.headers.get("X-TPU-Draining"):
+                drained = (resp.headers.get("Retry-After"), resp.read())
+                conn.close()
+                self.pool.note_draining(addr)
+                self.metrics.draining_skips.inc()
+                last_err = f"backend {addr} draining"
+                log.info("backend %s draining; trying next", addr)
                 continue
             # Phase 2.5: a 429 means the backend SHED the request at
             # admission — nothing was generated, so (unlike any other
@@ -524,27 +727,75 @@ class RouterHandler(BaseHTTPRequestHandler):
                     time.sleep(RETRY_429_BACKOFF_S
                                * (0.5 + _random.random()))
                     continue
-                self.metrics.requests.inc(code="429")
-                self.send_response(429)
-                self.send_header("Content-Type", "application/json")
-                if shed[0]:
-                    self.send_header("Retry-After", shed[0])
-                self.send_header("Content-Length", str(len(shed[1])))
-                self.end_headers()
-                self.wfile.write(shed[1])
+                if fo_state["headers_sent"]:
+                    # a continuation shed everywhere: the open stream cannot
+                    # become a 429 now — truncate
+                    self.close_connection = True
+                    return
+                self._relay_shed(shed)
                 return
-            # Phase 3: relay to the client. A 4xx/5xx status is the app's
-            # answer, not a dead replica — passed through as-is. A failure
-            # while relaying must NOT retry another replica (that would splice
-            # a second status line into the body) and a client disconnect
-            # (BrokenPipeError) must NOT mark the backend dead.
+            ctype = resp.headers.get("Content-Type", "application/json")
             if affinity_key is not None and resp.status < 500:
                 # this replica now holds the prefix's pages — stick to it
                 self.pool.note_affinity(affinity_key, addr)
+            # Phase 3a: failover-capable SSE relay — COMPLETE events only
+            # (the client must never hold half an event when the stream
+            # switches replicas), each accounted into fo_state.
+            if fo is not None and resp.status == 200 \
+                    and "text/event-stream" in ctype:
+                if not fo_state["headers_sent"]:
+                    self.metrics.requests.inc(code="200")
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    fo_state["headers_sent"] = True
+                outcome = self._relay_sse(resp, addr, fo_state)
+                conn.close()
+                if outcome == "done":
+                    return
+                if outcome == "client_gone":
+                    # client disconnect, NOT a backend failure: no failover,
+                    # no dead-mark (the backend cancels via broken pipe)
+                    log.info("client disconnected mid-stream")
+                    self.close_connection = True
+                    return
+                self.pool.mark_dead(addr)
+                self.metrics.dead_marks.inc()
+                if fo_state["failovers"] >= STREAM_FAILOVER_BUDGET \
+                        or (fo_state["chars"] and not fo_state["tagged"]):
+                    # can't (backend never tagged token ids) or won't
+                    # (budget spent) continue: truncate, the pre-r8 behavior
+                    log.warning("backend %s died mid-stream; NOT failing "
+                                "over (tagged=%s, failovers=%d)", addr,
+                                fo_state["tagged"], fo_state["failovers"])
+                    self.close_connection = True
+                    return
+                fo_state["failovers"] += 1
+                self.metrics.stream_failovers.inc()
+                cur_body = _continuation_body(fo, fo_state)
+                log.warning("backend %s died mid-stream after %d tokens / "
+                            "%d chars; continuing on the next replica",
+                            addr, len(fo_state["token_ids"]),
+                            fo_state["chars"])
+                continue
+            if fo_state["headers_sent"]:
+                # a continuation answered something that isn't a stream
+                # (4xx/5xx app error): the open SSE response cannot change
+                # status — truncate
+                conn.close()
+                log.warning("continuation on %s answered %s; truncating "
+                            "stream", addr, resp.status)
+                self.close_connection = True
+                return
+            # Phase 3b: plain relay. A 4xx/5xx status is the app's answer,
+            # not a dead replica — passed through as-is. A failure while
+            # relaying must NOT retry another replica (that would splice a
+            # second status line into the body) and a client disconnect
+            # (BrokenPipeError) must NOT mark the backend dead.
             try:
                 self.metrics.requests.inc(code=str(resp.status))
                 self.send_response(resp.status)
-                ctype = resp.headers.get("Content-Type", "application/json")
                 self.send_header("Content-Type", ctype)
                 if "text/event-stream" in ctype:
                     # SSE: stream chunks through unbuffered; connection close
@@ -578,21 +829,76 @@ class RouterHandler(BaseHTTPRequestHandler):
             finally:
                 conn.close()
             return
+        if fo_state["headers_sent"]:
+            # a mid-stream failover ran out of replicas: truncate
+            log.warning("stream abandoned: no replica could continue it")
+            self.close_connection = True
+            return
         if shed is not None:
             # every connectable replica shed the request: the honest answer
             # is the overload signal itself, not a 502
-            self.metrics.requests.inc(code="429")
-            self.send_response(429)
+            self._relay_shed(shed)
+            return
+        if drained is not None:
+            # the whole pool is draining (rolling restart trough): the
+            # honest answer is the draining 503 + Retry-After, not a 502
+            self.metrics.requests.inc(code="503")
+            self.send_response(503)
             self.send_header("Content-Type", "application/json")
-            if shed[0]:
-                self.send_header("Retry-After", shed[0])
-            self.send_header("Content-Length", str(len(shed[1])))
+            self.send_header("X-TPU-Draining", "1")
+            if drained[0]:
+                self.send_header("Retry-After", drained[0])
+            self.send_header("Content-Length", str(len(drained[1])))
             self.end_headers()
-            self.wfile.write(shed[1])
+            self.wfile.write(drained[1])
             return
         self.metrics.requests.inc(code="502")
         self._respond_json(502, {"error": {
             "message": f"all backends failed: {last_err}", "type": "router_error"}})
+
+    def _relay_shed(self, shed):
+        """Answer with the backend's own 429 (Retry-After preserved)."""
+        self.metrics.requests.inc(code="429")
+        self.send_response(429)
+        self.send_header("Content-Type", "application/json")
+        if shed[0]:
+            self.send_header("Retry-After", shed[0])
+        self.send_header("Content-Length", str(len(shed[1])))
+        self.end_headers()
+        self.wfile.write(shed[1])
+
+    def _relay_sse(self, resp, addr: str, st: dict) -> str:
+        """Relay COMPLETE SSE events to the client, accounting each into the
+        failover state (token ids / chars / [DONE]). Whole-event forwarding
+        is what makes a mid-stream death spliceable: the client never holds
+        half an event when the stream switches replicas. Returns ``"done"``
+        (stream ended cleanly), ``"backend_died"`` (socket error, premature
+        EOF, or chunked-body truncation), or ``"client_gone"``."""
+        ch = _chaos.get()
+        read1 = getattr(resp, "read1", None) or resp.read
+        buf = b""
+        n_events = 0
+        while True:
+            try:
+                if ch.enabled:
+                    # router-side fault point: injected mid-stream read error
+                    ch.check_stream_read(addr, n_events)
+                data = read1(4096)
+            except (OSError, http.client.HTTPException):
+                return "backend_died"
+            if not data:
+                # clean EOF before [DONE] = the replica shut down mid-stream
+                return "done" if st["done"] else "backend_died"
+            buf += data
+            while b"\n\n" in buf:
+                event, buf = buf.split(b"\n\n", 1)
+                try:
+                    self.wfile.write(event + b"\n\n")
+                    self.wfile.flush()
+                except OSError:
+                    return "client_gone"
+                _track_sse_event(event, st)
+                n_events += 1
 
     def do_GET(self):
         self._proxy("GET")

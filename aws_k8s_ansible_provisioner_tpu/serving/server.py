@@ -72,6 +72,73 @@ class ServerState:
         self.started = _now()
         # Serializes /debug/profile captures (one JAX trace at a time).
         self.profile_lock = threading.Lock()
+        # Graceful drain (r8): set by serve() so the SIGTERM handler /
+        # /admin/drain can stop the process after the drain quiesces.
+        self.stop_event: Optional[threading.Event] = None
+        self._drain_lock = threading.Lock()
+        self._drain_watcher: Optional[threading.Thread] = None
+        # /v1/* requests currently inside a handler thread: the drain
+        # watcher exits only when the ENGINE is idle AND every handler has
+        # finished writing its response — zero dropped in-flight requests.
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    def inflight_inc(self):
+        with self._inflight_lock:
+            self._inflight += 1
+
+    def inflight_dec(self):
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def begin_drain(self, timeout_s: Optional[float] = None,
+                    exit_when_idle: bool = True) -> float:
+        """Flip the engine to draining and (by default) arm the watcher that
+        stops the server once in-flight work finishes — the SIGTERM /
+        preStop path. ``exit_when_idle=False`` drains WITHOUT scheduling an
+        exit (operator takes a replica out of rotation but keeps the
+        process; /admin/undrain reverses it). Idempotent."""
+        t = self.engine.begin_drain(timeout_s)
+        if not exit_when_idle:
+            return t
+        with self._drain_lock:
+            if self._drain_watcher is None:
+                self._drain_watcher = threading.Thread(
+                    target=self._drain_watch, daemon=True,
+                    name="drain-watcher")
+                self._drain_watcher.start()
+        return t
+
+    def end_drain(self):
+        self.engine.end_drain()
+
+    def _drain_watch(self):
+        """Stop the server once the drain quiesces: engine idle (no active
+        slots, no queue, no chunk walk) and no /v1 handler still writing.
+        Past the drain deadline (+grace for the deadline reaper to finish
+        the stragglers it cancelled) the stop is forced — the reaper
+        guarantees slots/pages were released exactly once either way."""
+        eng = self.engine
+        while True:
+            if not eng.draining:        # drain cancelled via /admin/undrain
+                with self._drain_lock:
+                    self._drain_watcher = None
+                return
+            idle = (not eng._active_slots() and not eng.pending
+                    and eng._chunk is None and self.inflight == 0)
+            if idle or time.monotonic() > eng._drain_deadline + 5.0:
+                break
+            time.sleep(0.05)
+        log.info("drain complete (inflight=%d active=%d queued=%d); "
+                 "stopping server", self.inflight,
+                 len(eng._active_slots()), len(eng.pending))
+        if self.stop_event is not None:
+            self.stop_event.set()
 
 
 def _format_logprobs(tokenizer, ids, lp_data, k: int, chat: bool,
@@ -169,7 +236,16 @@ class Handler(BaseHTTPRequestHandler):
     def _overloaded(self, e: EngineOverloaded):
         """429 + Retry-After: the structured load-shed answer. The router
         treats this as a routable signal (another replica may have room);
-        clients back off by the hint."""
+        clients back off by the hint. A DRAINING shed is 503 instead (the
+        replica is leaving, not full) with the X-TPU-Draining marker the
+        router keys on to re-route without dead-marking — shed at
+        admission, so re-routing is always safe."""
+        if e.reason == "draining":
+            return self._error(503, str(e), "unavailable_error",
+                               err_code="draining",
+                               headers={"Retry-After":
+                                        str(int(e.retry_after_s + 0.5)),
+                                        "X-TPU-Draining": "1"})
         self._error(429, str(e), "overloaded_error",
                     err_code=f"engine_overloaded:{e.reason}",
                     headers={"Retry-After": str(int(e.retry_after_s + 0.5))})
@@ -220,6 +296,11 @@ class Handler(BaseHTTPRequestHandler):
             status = "ok"
             if eng.last_error:
                 status = "degraded"
+            if eng.draining:
+                # deliberate lifecycle state, not a failure: /healthz stays
+                # 200 so the K8s LIVENESS probe never kills a pod
+                # mid-drain; readiness (/readyz) is what flips to 503
+                status = "draining"
             if stalled:
                 # a wedged device dispatch hangs inside step(); K8s liveness
                 # keys off this to restart the pod (the engine thread cannot
@@ -227,6 +308,7 @@ class Handler(BaseHTTPRequestHandler):
                 status = "stalled"
             self._json(503 if stalled else 200, {
                 "status": status,
+                "draining": bool(eng.draining),
                 "model": self.state.model_name,
                 "uptime_s": _now() - self.state.started,
                 "active_requests": len(eng._active_slots()),
@@ -251,15 +333,35 @@ class Handler(BaseHTTPRequestHandler):
                 "max_queue_depth": eng.serving.max_queue_depth or None,
                 "request_timeout_s": eng.serving.request_timeout_s or None,
             })
+        elif path == "/readyz":
+            # Readiness, distinct from liveness (r8): a DRAINING replica is
+            # alive (finishing streams; liveness must not kill it) but not
+            # ready (K8s stops routing Service traffic to it; the preStop +
+            # SIGTERM path relies on this ordering). Stalled is both.
+            eng = self.state.engine
+            if eng.draining:
+                self._json(503, {"status": "draining"},
+                           headers={"X-TPU-Draining": "1"})
+            elif eng.stalled_for_s:
+                self._json(503, {"status": "stalled"})
+            else:
+                self._json(200, {"status": "ready"})
         elif path == "/load":
             # Tiny load snapshot for the gateway's ~1 Hz poller (router.py
             # load-aware routing — VERDICT r3 next #5): kept separate from
             # /health (which runs stall diagnostics) and /metrics (whose
-            # render cost scales with series count).
+            # render cost scales with series count). ``draining`` removes
+            # the replica from the router's rotation without dead-marking
+            # it (it re-enters within one poll of draining going false).
             eng = self.state.engine
             self._json(200, {"active": len(eng._active_slots()),
                              "queued": len(eng.pending),
-                             "slots": eng.num_slots})
+                             "slots": eng.num_slots,
+                             "draining": bool(eng.draining)})
+        elif path == "/admin/drain":
+            # K8s lifecycle httpGet handlers can only GET; same semantics
+            # as the POST (default timeout, exit when idle)
+            self._admin_drain({})
         elif path == "/debug/profile":
             self._profile()
         else:
@@ -309,11 +411,22 @@ class Handler(BaseHTTPRequestHandler):
         body = self._read_body()
         if body is None:
             return
+        track = path.startswith("/v1/")
+        if track:
+            # the drain watcher waits for this to hit zero: a response still
+            # being written is in-flight work a graceful shutdown must not
+            # cut (admin/probe traffic deliberately doesn't count)
+            self.state.inflight_inc()
         try:
             if path == "/v1/completions":
                 self._completions(body, chat=False)
             elif path == "/v1/chat/completions":
                 self._completions(body, chat=True)
+            elif path == "/admin/drain":
+                self._admin_drain(body)
+            elif path == "/admin/undrain":
+                self.state.end_drain()
+                self._json(200, {"status": "ok", "draining": False})
             else:
                 self._error(404, f"no route for POST {path}")
         except BrokenPipeError:
@@ -324,6 +437,32 @@ class Handler(BaseHTTPRequestHandler):
                 self._error(500, f"{type(e).__name__}: {e}", "internal_error")
             except Exception:
                 pass
+        finally:
+            if track:
+                self.state.inflight_dec()
+
+    def _admin_drain(self, body: dict):
+        """Begin a graceful drain (the preStop hook's target; SIGTERM takes
+        the same path): stop admitting, finish in-flight work up to
+        ``timeout_s`` (default drain_timeout_s), then stop the server —
+        unless ``exit: false`` (drain for rotation-removal only;
+        /admin/undrain reverses it)."""
+        eng = self.state.engine
+        try:
+            timeout_s = body.get("timeout_s")
+            if timeout_s is not None:
+                timeout_s = float(timeout_s)
+        except (TypeError, ValueError):
+            return self._error(400, "'timeout_s' must be a number")
+        exit_when_idle = bool(body.get("exit", True))
+        t = self.state.begin_drain(timeout_s, exit_when_idle=exit_when_idle)
+        log.info("drain requested (timeout %.1fs, exit=%s): %d active, "
+                 "%d queued", t, exit_when_idle,
+                 len(eng._active_slots()), len(eng.pending))
+        self._json(200, {"status": "draining", "drain_timeout_s": t,
+                         "exit_when_idle": exit_when_idle,
+                         "active_requests": len(eng._active_slots()),
+                         "queue_depth": len(eng.pending)})
 
     def _completions(self, body: dict, chat: bool):
         st = self.state
@@ -365,8 +504,11 @@ class Handler(BaseHTTPRequestHandler):
         if not (0.0 < repetition_penalty <= 10.0):
             return self._error(400, "'repetition_penalty' must be in "
                                     "(0, 10]")
-        if max_tokens < 1 or max_tokens > st.engine.max_len:
-            return self._error(400, f"max_tokens must be in [1, "
+        # a continuation's max_tokens means REMAINING budget (the router
+        # decrements it by the already-relayed tokens), so 0 is legal there
+        min_mt = 0 if body.get("resume_token_ids") is not None else 1
+        if max_tokens < min_mt or max_tokens > st.engine.max_len:
+            return self._error(400, f"max_tokens must be in [{min_mt}, "
                                     f"{st.engine.max_len}]")
         stops = body.get("stop") or []
         if isinstance(stops, str):
@@ -517,6 +659,48 @@ class Handler(BaseHTTPRequestHandler):
         if so and not stream:
             return self._error(400, "'stream_options' requires stream=true")
         include_usage = bool(so.get("include_usage", False))
+        # Mid-stream failover continuation (r8): the router re-issues a
+        # dying stream carrying the token ids it already relayed
+        # (resume_token_ids) and how much generated text the client already
+        # received (resume_text_chars). The engine re-prefills
+        # prompt + resume as a cache rebuild; the seeded draws continue at
+        # the exact positions the dead replica would have used, and
+        # _stream_response splices only NEW bytes to the client. max_tokens
+        # in a continuation body is the REMAINING budget; the engine's is
+        # total generated, so the resume length is added back (a body
+        # without max_tokens keeps the default as the TOTAL budget —
+        # exactly the original request's).
+        raw_resume = body.get("resume_token_ids")
+        resume_ids: tuple = ()
+        resume_chars = 0
+        if raw_resume is not None:
+            if not isinstance(raw_resume, list):
+                return self._error(400, "'resume_token_ids' must be a list "
+                                        "of token ids")
+            try:
+                resume_ids = tuple(int(t) for t in raw_resume)
+                resume_chars = int(body.get("resume_text_chars", 0))
+            except (TypeError, ValueError):
+                return self._error(400, "'resume_token_ids' must be integers"
+                                        " and 'resume_text_chars' an "
+                                        "integer")
+            if resume_chars < 0:
+                return self._error(400, "'resume_text_chars' must be >= 0")
+            if not stream:
+                return self._error(400, "'resume_token_ids' requires "
+                                        "stream=true")
+            if n_choices != 1 or best_of != 1:
+                return self._error(400, "continuation supports a single "
+                                        "choice (n=1, best_of=1)")
+            if echo:
+                return self._error(400, "continuation cannot combine with "
+                                        "'echo' (the prompt was already "
+                                        "streamed)")
+            if plp is not None:
+                return self._error(400, "continuation cannot carry "
+                                        "prompt_logprobs")
+            if "max_tokens" in body:
+                max_tokens += len(resume_ids)
         # Constrained output via the grammar-mask sampler (serving/guided.py):
         # OpenAI ``response_format`` (json_object/json_schema) plus vLLM's
         # guided_json / guided_regex / guided_choice extensions. Compiled
@@ -543,6 +727,26 @@ class Handler(BaseHTTPRequestHandler):
             # prompt); otherwise keep the pre-r5 generated-only payload
             # instead of breaking previously-working requests (review r5)
             plp = lp_n
+        if raw_resume is not None:
+            # A relayed prefix that ALREADY satisfies a stop condition must
+            # not decode further (the engine would generate past the point
+            # the undisturbed stream stopped — only the finish chunk was
+            # lost with the dead replica). Mirrors _emit's stop logic.
+            fin = None
+            if resume_ids:
+                last = resume_ids[-1]
+                if (((last in st.engine._eos_set and not ignore_eos)
+                     or last in stop_token_ids)
+                        and len(resume_ids) > min_tokens):
+                    fin = "stop"
+            if fin is None and len(resume_ids) >= max_tokens:
+                fin = "length"
+            if fin is not None:
+                rid = ("chatcmpl-" if chat else "cmpl-") \
+                    + uuid.uuid4().hex[:24]
+                return self._finished_stream(
+                    rid, chat, model, fin, n_prompt=len(prompt_ids),
+                    n_gen=len(resume_ids), include_usage=include_usage)
         # best_of ranking needs each candidate's chosen-token logprobs; ask
         # the engine for them even when the client didn't (the response
         # strips them again — lp_requested below).
@@ -570,7 +774,7 @@ class Handler(BaseHTTPRequestHandler):
                     logit_bias=logit_bias, guided=guided,
                     ignore_eos=ignore_eos,
                     lora=lora_name, prompt_logprobs=plp,
-                    deadline_s=deadline_s,
+                    deadline_s=deadline_s, resume_ids=resume_ids,
                     seed=None if seed is None else seed + i,
                     **({"out_queue": _NotifyQueue(notify)} if notify else {})))
         except EngineOverloaded as e:
@@ -595,7 +799,9 @@ class Handler(BaseHTTPRequestHandler):
                                   n_prompt=len(prompt_ids),
                                   include_usage=include_usage,
                                   echo_text=prompt_text if echo else None,
-                                  lp_k=lp_n)
+                                  lp_k=lp_n, resume_ids=resume_ids,
+                                  resume_chars=resume_chars,
+                                  is_resume=raw_resume is not None)
         else:
             self._full_response(reqs, rid, chat, stops, len(prompt_ids),
                                 model=model,
@@ -718,11 +924,54 @@ class Handler(BaseHTTPRequestHandler):
                          "model": model or st.model_name,
                          "choices": choices, "usage": usage})
 
+    def _finished_stream(self, rid: str, chat: bool, model: Optional[str],
+                         finish: str, n_prompt: int, n_gen: int,
+                         include_usage: bool):
+        """Degenerate continuation: the relayed prefix already satisfied a
+        stop condition — only the finish chunk (+usage, [DONE]) was lost
+        with the dead replica, so answer those directly without admitting
+        anything to the engine."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        obj = "chat.completion.chunk" if chat else "text_completion"
+
+        def raw_write(data: bytes):
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        payload = {"index": 0, "finish_reason": finish}
+        if chat:
+            payload["delta"] = {}
+        else:
+            payload["text"] = ""
+        body = {"id": rid, "object": obj, "created": _now(),
+                "model": model or self.state.model_name,
+                "choices": [payload]}
+        if include_usage:
+            body["usage"] = None
+        raw_write(f"data: {json.dumps(body)}\n\n".encode())
+        if include_usage:
+            raw_write(("data: " + json.dumps({
+                "id": rid, "object": obj, "created": _now(),
+                "model": model or self.state.model_name, "choices": [],
+                "usage": {"prompt_tokens": n_prompt,
+                          "completion_tokens": n_gen,
+                          "total_tokens": n_prompt + n_gen},
+                "failover": True}) + "\n\n").encode())
+        raw_write(b"data: [DONE]\n\n")
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
+
     def _stream_response(self, reqs, rid: str, chat: bool, stops: List[str],
                          model: Optional[str] = None,
                          n_prompt: int = 0, include_usage: bool = False,
                          echo_text: Optional[str] = None,
-                         lp_k: Optional[int] = None):
+                         lp_k: Optional[int] = None,
+                         resume_ids: tuple = (), resume_chars: int = 0,
+                         is_resume: bool = False):
         """SSE streaming with incremental detokenization (n choices).
 
         Correctness over eagerness: text is held back while it could still be
@@ -730,9 +979,18 @@ class Handler(BaseHTTPRequestHandler):
         this) or (b) a prefix of a stop string (``hold`` chars withheld), so a
         client never sees bytes that a later token retroactively changes.
         A broken pipe cancels the engine request so the decode slot frees.
+
+        Every content chunk carries the generated ``token_ids`` it covers —
+        the router buffers them per stream so a replica death mid-stream can
+        fail over as a deterministic continuation. A continuation
+        (``is_resume``) pre-feeds the detokenizer with the already-relayed
+        ``resume_ids`` and SKIPS the first ``resume_chars`` of generated
+        text: the client receives only chunks it hasn't seen, and the
+        concatenated stream is byte-identical to an undisturbed run.
         """
         from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import (
             IncrementalDetokenizer)
+        from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
 
         st = self.state
         self.send_response(200)
@@ -746,10 +1004,12 @@ class Handler(BaseHTTPRequestHandler):
             self.wfile.flush()
 
         obj = "chat.completion.chunk" if chat else "text_completion"
+        _sent = {"chunks": 0}
 
         def chunk(idx: int, delta_text: Optional[str],
                   finish_reason: Optional[str], role: bool = False,
-                  lp: Optional[dict] = None):
+                  lp: Optional[dict] = None,
+                  tok_ids: Optional[List[int]] = None):
             payload = {"index": idx, "finish_reason": finish_reason}
             if chat:
                 d = {}
@@ -762,6 +1022,12 @@ class Handler(BaseHTTPRequestHandler):
                 payload["text"] = delta_text or ""
             if lp is not None:
                 payload["logprobs"] = lp
+            if tok_ids:
+                # failover bookkeeping (r8): the generated token ids this
+                # chunk covers. OpenAI clients ignore the extra field; the
+                # router accumulates them so a mid-stream replica death can
+                # re-issue the request as a deterministic continuation.
+                payload["token_ids"] = [int(t) for t in tok_ids]
             body = {"id": rid, "object": obj, "created": _now(),
                     "model": model or st.model_name,
                     "choices": [payload]}
@@ -771,6 +1037,22 @@ class Handler(BaseHTTPRequestHandler):
                 # choices-less chunk before [DONE]
                 body["usage"] = None
             raw_write(f"data: {json.dumps(body)}\n\n".encode())
+            if delta_text or tok_ids:
+                _sent["chunks"] += 1
+                ch = _chaos.get()
+                if ch.enabled:
+                    # kill_replica_after_chunks fault point: may RST the
+                    # connection and raise (unwound like a broken pipe)
+                    ch.on_stream_chunk(self, _sent["chunks"])
+
+        def consume_skip(s, text: str) -> str:
+            """Drop the leading chars a failed-over client already received
+            (continuation streams only; no-op otherwise)."""
+            if s["skip"] and text:
+                k = min(s["skip"], len(text))
+                s["skip"] -= k
+                text = text[k:]
+            return text
 
         # Per-choice state: the n > 1 sibling requests ride the same
         # continuous batch, so their tokens arrive interleaved — each choice
@@ -779,9 +1061,27 @@ class Handler(BaseHTTPRequestHandler):
         hold = max((len(s) for s in stops if s), default=1) - 1
         base_off = len(echo_text) if echo_text else 0
         states = [{"req": r, "detok": IncrementalDetokenizer(st.tokenizer),
-                   "pending": "", "finish": None, "n_lp": 0,
+                   "pending": "", "finish": None, "n_lp": 0, "skip": 0,
+                   "carry": "", "tok_pending": [],
                    "acc": "", "offset": base_off} for r in reqs]
         multi = len(states) > 1
+        if is_resume and states:
+            # Continuation: rebuild the detokenizer over the already-relayed
+            # tokens so the first NEW token's delta merges correctly, then
+            # arm the skip that drops what the client already has. The
+            # flushed prior text re-enters the normal pending/hold pipeline
+            # (non-lp) or the first chunk's carry (lp) — whatever the dead
+            # replica had flushed-but-held arrives with the first new chunk.
+            s = states[0]
+            prior = "".join(s["detok"].push(int(t)) for t in resume_ids)
+            skip = min(int(resume_chars), len(prior))
+            s["acc"] = prior
+            s["offset"] = base_off + len(prior)
+            if lp_k is not None:
+                s["carry"] = prior
+            else:
+                s["pending"] = prior
+            s["skip"] = skip
 
         def token_lp(s, token: int, delta: str):
             """Per-token logprob payload for a streamed chunk — the vLLM
@@ -824,6 +1124,8 @@ class Handler(BaseHTTPRequestHandler):
                 if item is None:
                     tail = s["detok"].finish()
                     s["finish"] = s["req"].finish_reason or "stop"
+                    tail = consume_skip(s, s["carry"] + tail)
+                    s["carry"] = ""
                     if tail:
                         chunk(i, tail, None)
                     chunk(i, None, s["finish"])
@@ -843,7 +1145,14 @@ class Handler(BaseHTTPRequestHandler):
                         if overshoot <= len(delta) else ""
                     s["finish"] = "stop"
                     st.engine.cancel(s["req"])
-                chunk(i, delta, None, lp=token_lp(s, item, delta))
+                if s["carry"]:
+                    # continuation: the rebuilt prior text (beyond what the
+                    # client already has — consume_skip drops that part)
+                    # rides the first new token's chunk
+                    delta, s["carry"] = s["carry"] + delta, ""
+                delta = consume_skip(s, delta)
+                chunk(i, delta, None, lp=token_lp(s, item, delta),
+                      tok_ids=[int(item)])
                 if s["finish"]:
                     chunk(i, None, s["finish"])
                 return True
@@ -852,6 +1161,7 @@ class Handler(BaseHTTPRequestHandler):
                 s["finish"] = s["req"].finish_reason or "stop"
             else:
                 s["pending"] += s["detok"].push(item)
+                s["tok_pending"].append(int(item))
             cut_text = _apply_stop_strings(s["pending"], stops)
             if cut_text is not None:
                 s["pending"], s["finish"] = cut_text, "stop"
@@ -860,10 +1170,14 @@ class Handler(BaseHTTPRequestHandler):
                 s["pending"][:len(s["pending"]) - hold] if hold
                 else s["pending"])
             if ready:
-                chunk(i, ready, None)
+                send = consume_skip(s, ready)
+                if send or s["tok_pending"]:
+                    chunk(i, send, None, tok_ids=s["tok_pending"])
+                    s["tok_pending"] = []
                 s["pending"] = s["pending"][len(ready):]
             if s["finish"]:
-                chunk(i, None, s["finish"])
+                chunk(i, None, s["finish"], tok_ids=s["tok_pending"])
+                s["tok_pending"] = []
             return True
 
         # No-progress backstop (r7): the configured deadline default, not a
@@ -876,6 +1190,11 @@ class Handler(BaseHTTPRequestHandler):
             stall_s = threading.TIMEOUT_MAX
         try:
             for i in range(len(states)):
+                if is_resume:
+                    # the client got the role/echo chunk from the replica
+                    # that died; a continuation re-sending it would splice
+                    # duplicate bytes into the stream
+                    break
                 if chat:
                     chunk(i, "", None, role=True)
                 elif echo_text:
@@ -920,14 +1239,20 @@ class Handler(BaseHTTPRequestHandler):
                     raise TimeoutError(
                         f"no stream progress in {stall_s:.0f}s")
             if include_usage:
+                # generated includes the resume prefix on a continuation, so
+                # usage matches the undisturbed run; ``failover: true`` is
+                # the client-visible marker that this stream was failed over
                 n_gen = sum(len(s["req"].generated) for s in states)
-                raw_write(("data: " + json.dumps({
+                final = {
                     "id": rid, "object": obj, "created": _now(),
                     "model": model or st.model_name, "choices": [],
                     "usage": {"prompt_tokens": n_prompt,
                               "completion_tokens": n_gen,
                               "total_tokens": n_prompt + n_gen},
-                }) + "\n\n").encode())
+                }
+                if is_resume:
+                    final["failover"] = True
+                raw_write(("data: " + json.dumps(final) + "\n\n").encode())
             raw_write(b"data: [DONE]\n\n")
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
@@ -1065,8 +1390,14 @@ def build_state(serving_cfg=None, model_cfg=None, params=None,
 def serve(state: ServerState, host: str, port: int,
           ready_event: Optional[threading.Event] = None,
           stop_event: Optional[threading.Event] = None):
-    """Run engine thread + HTTP server until stop_event (or forever)."""
+    """Run engine thread + HTTP server until stop_event (or forever).
+
+    The HTTP server always runs on its own thread and this function blocks
+    on ``stop_event`` — the one shape that lets a SIGTERM handler or
+    POST /admin/drain stop the process from any thread after a graceful
+    drain (state.begin_drain sets the stop once in-flight work finishes)."""
     stop = stop_event or threading.Event()
+    state.stop_event = stop
     engine_thread = threading.Thread(
         target=state.engine.run_forever, args=(stop,), daemon=True,
         name="engine-loop")
@@ -1080,23 +1411,21 @@ def serve(state: ServerState, host: str, port: int,
     httpd.daemon_threads = True
     log.info("serving %s on %s:%d (%d slots, cache %d)", state.model_name,
              host, port, state.engine.num_slots, state.engine.max_len)
+    server_thread = threading.Thread(target=httpd.serve_forever,
+                                     daemon=True, name="http")
+    server_thread.start()
     if ready_event is not None:
-        server_thread = threading.Thread(target=httpd.serve_forever,
-                                         daemon=True, name="http")
-        server_thread.start()
         ready_event.set()
+    try:
         stop.wait()
-        httpd.shutdown()
-        # Close the LISTENING socket too: shutdown() only stops the accept
-        # loop, leaving connects to land in the kernel backlog and black-hole
-        # — a stopped replica must refuse connections so a gateway's
-        # connect-phase failover (router.py) sees it dead immediately.
-        httpd.server_close()
-    else:
-        try:
-            httpd.serve_forever()
-        finally:
-            stop.set()
+    except KeyboardInterrupt:
+        stop.set()
+    httpd.shutdown()
+    # Close the LISTENING socket too: shutdown() only stops the accept
+    # loop, leaving connects to land in the kernel backlog and black-hole
+    # — a stopped replica must refuse connections so a gateway's
+    # connect-phase failover (router.py) sees it dead immediately.
+    httpd.server_close()
 
 
 def main(argv=None):
@@ -1171,6 +1500,12 @@ def main(argv=None):
     p.add_argument("--max-queue-depth", type=int, default=256,
                    help="bounded engine queue: admissions past this depth "
                         "are shed with 429 + Retry-After (0 = unbounded)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="graceful-drain budget in seconds: on SIGTERM or "
+                        "POST /admin/drain, stop admitting (503 draining, "
+                        "/readyz 503) and let in-flight requests finish up "
+                        "to this long before exiting 0; stragglers are "
+                        "cancelled through the deadline path")
     p.add_argument("--admission-max-wait", type=float, default=0.0,
                    help="shed admissions whose estimated queue wait "
                         "(seconds) exceeds this (0 disables)")
@@ -1223,6 +1558,7 @@ def main(argv=None):
         request_timeout_s=args.request_timeout,
         max_queue_depth=args.max_queue_depth,
         admission_max_wait_s=args.admission_max_wait,
+        drain_timeout_s=args.drain_timeout,
         mesh=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep))
     state = build_state(serving)
     if not args.no_warmup:
@@ -1231,7 +1567,21 @@ def main(argv=None):
         t0 = time.time()
         state.engine.warmup()
         log.info("warmup done in %.1fs", time.time() - t0)
+    # Graceful termination (r8): SIGTERM (k8s pod deletion, after the
+    # preStop hook's explicit /admin/drain) flips the engine to draining —
+    # new requests shed 503, /readyz 503 so the Service stops routing here,
+    # in-flight requests finish up to drain_timeout_s — then serve()'s stop
+    # fires and the process exits 0 with zero dropped in-flight requests.
+    import signal
+
+    def _on_sigterm(signum, frame):
+        log.info("SIGTERM: graceful drain (timeout %.1fs)",
+                 args.drain_timeout)
+        state.begin_drain()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     serve(state, args.host, args.port)
+    log.info("drained and stopped; exiting 0")
 
 
 if __name__ == "__main__":

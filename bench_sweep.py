@@ -254,6 +254,150 @@ def router_bench(n_streams: int, n_groups: int, n_replicas: int,
     return 0 if done == n_requests and fo_ms is not None else 1
 
 
+def overload_bench(levels, n_replicas: int, n_requests: int,
+                   out_path: str) -> int:
+    """Shed-rate-vs-offered-load curve through the REAL router (ROADMAP
+    robustness follow-on; the overload analogue of ROUTER_BENCH).
+
+    Replicas run deliberately TIGHT admission (2 slots, queue depth 2) so
+    offered load sweeps from under- to over-subscribed on CPU in seconds.
+    At each concurrency level the client-visible outcomes split into
+    completed vs shed — a 429 reaches the client only after the router's
+    retry chain found EVERY replica full, so the curve measures the
+    system's admission behavior, not one replica's. The expected shape:
+    ~0 shed while offered <= capacity, then a rising shed rate with
+    completed throughput holding (the engine keeps serving what it
+    admitted — overload degrades by policy, not collapse)."""
+    import statistics
+    import threading
+    import urllib.error
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")   # admission mechanics, not chip perf
+    import jax.numpy as jnp
+
+    from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+    from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+    from aws_k8s_ansible_provisioner_tpu.serving.router import (
+        BackendPool, RouterHandler, RouterMetrics, start_load_poller)
+    from aws_k8s_ansible_provisioner_tpu.serving.server import (
+        build_state, serve)
+    from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+    BASE = 18600
+    stops = []
+    for i in range(n_replicas):
+        tok = ByteTokenizer()
+        cfg = tiny_qwen3(vocab_size=tok.vocab_size,
+                         eos_token_id=tok.eos_token_id, max_seq_len=256)
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        serving = ServingConfig(model="tiny-qwen3", max_decode_slots=2,
+                                max_cache_len=256,
+                                prefill_buckets=(32, 64),
+                                max_queue_depth=2,
+                                dtype="float32")
+        state = build_state(serving, model_cfg=cfg, params=params,
+                            tokenizer=tok)
+        ready, stop = threading.Event(), threading.Event()
+        threading.Thread(target=serve,
+                         args=(state, "127.0.0.1", BASE + i, ready, stop),
+                         daemon=True).start()
+        assert ready.wait(60), f"replica {i} failed to start"
+        stops.append(stop)
+    addrs = ",".join(f"127.0.0.1:{BASE + i}" for i in range(n_replicas))
+    RouterHandler.pool = BackendPool(addrs, cooldown_s=5.0)
+    RouterHandler.metrics = RouterMetrics()
+    poll_stop = threading.Event()
+    start_load_poller(RouterHandler.pool, interval_s=0.2, stop=poll_stop)
+    router = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    rurl = f"http://127.0.0.1:{router.server_port}"
+
+    curve = []
+    for conc in levels:
+        lock = threading.Lock()
+        work = list(range(n_requests))
+        done, shed, errors, lat = [], [], [], []
+
+        def client():
+            while True:
+                with lock:
+                    if not work:
+                        return
+                    i = work.pop()
+                body = json.dumps({
+                    "model": "tiny-qwen3", "max_tokens": 16,
+                    "prompt": f"overload probe {i}", "ignore_eos": True,
+                }).encode()
+                req = urllib.request.Request(
+                    rurl + "/v1/completions", data=body,
+                    headers={"Content-Type": "application/json"})
+                t0 = time.monotonic()
+                try:
+                    with urllib.request.urlopen(req, timeout=120) as r:
+                        r.read()
+                    with lock:
+                        done.append(i)
+                        lat.append(time.monotonic() - t0)
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    with lock:
+                        (shed if e.code == 429 else errors).append(e.code)
+                except Exception as e:     # noqa: BLE001 — record, don't die
+                    with lock:
+                        errors.append(str(e)[:60])
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=client) for _ in range(conc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = max(time.monotonic() - t0, 1e-6)
+        ls = sorted(lat)
+        curve.append({
+            "concurrency": conc,
+            "offered": n_requests,
+            "offered_rps": round(n_requests / wall, 2),
+            "completed": len(done),
+            "shed": len(shed),
+            "failed": len(errors),
+            "shed_rate": round(len(shed) / n_requests, 3),
+            "completed_rps": round(len(done) / wall, 2),
+            "latency_p50_ms": round(1e3 * ls[len(ls) // 2], 1) if ls else None,
+            "latency_p95_ms": round(1e3 * ls[int(len(ls) * 0.95)], 1)
+            if ls else None,
+        })
+        sys.stderr.write(f"overload: conc={conc} -> {curve[-1]}\n")
+
+    poll_stop.set()
+    router.shutdown()
+    for s in stops:
+        s.set()
+    m = RouterHandler.metrics
+    result = {
+        "mode": "overload_bench",
+        "platform": "cpu",
+        "n_replicas": n_replicas,
+        "slots_per_replica": 2,
+        "max_queue_depth": 2,
+        "requests_per_level": n_requests,
+        "router_429_retries": int(m.retries_429.total()),
+        "curve": curve,
+    }
+    with open(out_path, "w") as f:
+        f.write(json.dumps(result, indent=1) + "\n")
+    print(json.dumps(result))
+    # sanity: low offered load must mostly complete; the top level must
+    # actually exercise shedding (otherwise the curve measured nothing)
+    ok = (curve[0]["shed_rate"] < 0.5
+          and any(p["shed"] > 0 for p in curve))
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cap", type=float, default=420.0,
@@ -272,7 +416,21 @@ def main() -> int:
     ap.add_argument("--router-replicas", type=int, default=2)
     ap.add_argument("--router-requests", type=int, default=48)
     ap.add_argument("--router-out", default="ROUTER_BENCH.json")
+    ap.add_argument("--overload", action="store_true",
+                    help="overload mode (CPU): drive offered load through "
+                         "the router past the replicas' admission limits "
+                         "and write the shed-rate-vs-offered-load curve")
+    ap.add_argument("--overload-levels", default="1,2,4,8,16,32",
+                    help="comma-separated client-concurrency levels")
+    ap.add_argument("--overload-requests", type=int, default=40,
+                    help="requests fired per concurrency level")
+    ap.add_argument("--overload-replicas", type=int, default=2)
+    ap.add_argument("--overload-out", default="OVERLOAD_BENCH.json")
     args = ap.parse_args()
+    if args.overload:
+        levels = [int(x) for x in args.overload_levels.split(",") if x]
+        return overload_bench(levels, args.overload_replicas,
+                              args.overload_requests, args.overload_out)
     if args.router > 0:
         return router_bench(args.router, args.router_groups,
                             args.router_replicas, args.router_requests,

@@ -104,6 +104,18 @@ def _validate_workload(d: dict, name: str):
             if vm.get("name") not in declared_volumes:
                 _fail(name, f"{kind} {mname} container {c.get('name')} "
                             f"mounts undeclared volume {vm.get('name')!r}")
+        # Lifecycle pairing (r8): a container behind a readinessProbe takes
+        # Service traffic, so a rollout that deletes its pod must drain
+        # before SIGTERM — require a preStop hook (the serving engine's
+        # POSTs /admin/drain; the router's sleeps out in-flight relays). A
+        # readinessProbe without one reintroduces the
+        # dropped-streams-on-rollout failure this layer exists to close.
+        if c.get("readinessProbe") and not (c.get("lifecycle") or {}) \
+                .get("preStop"):
+            _fail(name, f"{kind} {mname} container {c.get('name')} has a "
+                        "readinessProbe but no lifecycle.preStop hook "
+                        "(rolling restarts would cut its in-flight "
+                        "requests; see serving.yaml.j2)")
 
 
 def kubeconform_validate(text: str, name: str) -> bool:

@@ -136,6 +136,7 @@ spec:
         - name: c
           image: img
           ports: [{name: http, containerPort: 8000}]
+          lifecycle: {preStop: {exec: {command: [sleep, "5"]}}}
           readinessProbe: {httpGet: {path: /health, port: http}}
 """
     assert vm.structural_validate(good, "good") == 1
@@ -144,6 +145,11 @@ spec:
              "selector"),
             (good.replace("port: http}", "port: htp}"), "probe"),
             (good.replace("          image: img\n", ""), "image"),
+            # r8: a readiness-probed container without a preStop hook
+            # would drop its in-flight requests at every rolling restart
+            (good.replace(
+                "          lifecycle: {preStop: {exec: "
+                "{command: [sleep, \"5\"]}}}\n", ""), "preStop"),
             (good.replace("img", "{{ framework_image }}"), "Jinja")):
         with pytest.raises(vm.ManifestError):
             vm.structural_validate(breakage, "broken")

@@ -477,3 +477,326 @@ def test_recovered_replica_reenters_rotation_within_cooldown():
     finally:
         stop.set()
         srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Replica lifecycle (r8): deadline decrement, drain-aware routing,
+# mid-stream failover continuation
+# ---------------------------------------------------------------------------
+
+
+def _fixed_order_pool(addrs):
+    class FixedOrder(BackendPool):
+        def pick(self, affinity_key=None):
+            return list(addrs)
+    return FixedOrder(",".join(addrs))
+
+
+def _router_with(pool):
+    old = RouterHandler.pool, RouterHandler.metrics
+    RouterHandler.pool = pool
+    RouterHandler.metrics = RouterMetrics()
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, old
+
+
+def test_deadline_decrements_across_shed_chain():
+    """Regression (ISSUE r8 satellite): X-Request-Deadline-Ms used to be
+    forwarded VERBATIM, handing every retry hop a fresh deadline while 429
+    backoff sleeps ate real wall-clock. A 2-replica shed chain must see a
+    strictly smaller deadline on the second hop."""
+    seen = []
+
+    class Shedding(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            self.rfile.read(n)
+            seen.append(self.headers.get("X-Request-Deadline-Ms"))
+            body = b'{"error": {"message": "full"}}'
+            self.send_response(429)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Retry-After", "1")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    b1 = ThreadingHTTPServer(("127.0.0.1", 0), Shedding)
+    b2 = ThreadingHTTPServer(("127.0.0.1", 0), Shedding)
+    for b in (b1, b2):
+        threading.Thread(target=b.serve_forever, daemon=True).start()
+    addrs = [f"127.0.0.1:{b.server_port}" for b in (b1, b2)]
+    router, old = _router_with(_fixed_order_pool(addrs))
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.server_port}/v1/completions",
+            data=json.dumps({"prompt": "x"}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Deadline-Ms": "5000"})
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "expected 429 after every replica shed"
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+        assert len(seen) == 2
+        first, second = (int(v) for v in seen)
+        assert 0 < first <= 5000
+        # the jittered 429 backoff (>= 50 ms) plus hop overhead must show
+        assert second < first
+    finally:
+        router.shutdown()
+        for b in (b1, b2):
+            b.shutdown()
+        RouterHandler.pool, RouterHandler.metrics = old
+
+
+def test_draining_503_reroutes_without_dead_mark():
+    """A 503 + X-TPU-Draining shed is ROUTABLE (shed at admission, nothing
+    generated): the router serves from the next replica, marks the origin
+    draining (not dead), and counts the re-route."""
+
+    class Draining(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            self.rfile.read(n)
+            body = b'{"error": {"message": "draining", "code": "draining"}}'
+            self.send_response(503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("X-TPU-Draining", "1")
+            self.send_header("Retry-After", "10")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    dr = ThreadingHTTPServer(("127.0.0.1", 0), Draining)
+    ok = ThreadingHTTPServer(("127.0.0.1", 0), FakeEngine)
+    for b in (dr, ok):
+        threading.Thread(target=b.serve_forever, daemon=True).start()
+    addrs = [f"127.0.0.1:{dr.server_port}", f"127.0.0.1:{ok.server_port}"]
+    router, old = _router_with(_fixed_order_pool(addrs))
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.server_port}/v1/completions",
+            data=json.dumps({"prompt": "x"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["port"] == ok.server_port
+        m = RouterHandler.metrics
+        assert m.draining_skips.total() == 1
+        assert m.dead_marks.total() == 0
+        assert addrs[0] in RouterHandler.pool.draining()
+        assert addrs[0] not in RouterHandler.pool.cooling()
+    finally:
+        router.shutdown()
+        for b in (dr, ok):
+            b.shutdown()
+        RouterHandler.pool, RouterHandler.metrics = old
+
+
+def test_all_replicas_draining_relays_503():
+    """A rolling-restart trough (every replica draining) answers the
+    honest 503 + Retry-After + X-TPU-Draining, not a 502."""
+
+    class Draining(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            self.rfile.read(n)
+            body = b'{"error": {"code": "draining"}}'
+            self.send_response(503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("X-TPU-Draining", "1")
+            self.send_header("Retry-After", "7")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    b1 = ThreadingHTTPServer(("127.0.0.1", 0), Draining)
+    b2 = ThreadingHTTPServer(("127.0.0.1", 0), Draining)
+    for b in (b1, b2):
+        threading.Thread(target=b.serve_forever, daemon=True).start()
+    addrs = [f"127.0.0.1:{b.server_port}" for b in (b1, b2)]
+    router, old = _router_with(_fixed_order_pool(addrs))
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.server_port}/v1/completions",
+            data=json.dumps({"prompt": "x"}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert e.headers.get("X-TPU-Draining") == "1"
+            assert e.headers.get("Retry-After") == "7"
+    finally:
+        router.shutdown()
+        for b in (b1, b2):
+            b.shutdown()
+        RouterHandler.pool, RouterHandler.metrics = old
+
+
+def test_mid_stream_failover_splices_continuation():
+    """Router mechanics of the failover, server-free: replica A streams two
+    token-tagged chunks then RSTs; the router must re-issue to replica B
+    with resume_token_ids/resume_text_chars and a DECREMENTED max_tokens,
+    splice only B's events after A's, and count one stream failover."""
+    import os as _os
+    import socket as _socket
+    import struct as _struct
+
+    got_body = {}
+
+    class DiesAfterTwo(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.end_headers()
+            for tid, txt in ((7, "a"), (9, "b")):
+                self.wfile.write(
+                    b'data: {"choices":[{"index":0,"text":"' + txt.encode()
+                    + b'","token_ids":[' + str(tid).encode() + b']}]}\n\n')
+            self.wfile.flush()
+            self.connection.setsockopt(_socket.SOL_SOCKET,
+                                       _socket.SO_LINGER,
+                                       _struct.pack("ii", 1, 0))
+            _os.close(self.connection.detach())
+
+    class Continues(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            got_body.update(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Connection", "close")  # close delimits body
+            self.end_headers()
+            self.wfile.write(
+                b'data: {"choices":[{"index":0,"text":"cd",'
+                b'"token_ids":[11,13]}]}\n\n'
+                b'data: {"choices":[{"index":0,"text":"",'
+                b'"finish_reason":"length"}]}\n\n'
+                b'data: [DONE]\n\n')
+            self.wfile.flush()
+            self.close_connection = True
+
+    b1 = ThreadingHTTPServer(("127.0.0.1", 0), DiesAfterTwo)
+    b2 = ThreadingHTTPServer(("127.0.0.1", 0), Continues)
+    for b in (b1, b2):
+        threading.Thread(target=b.serve_forever, daemon=True).start()
+    addrs = [f"127.0.0.1:{b1.server_port}", f"127.0.0.1:{b2.server_port}"]
+    router, old = _router_with(_fixed_order_pool(addrs))
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.server_port}/v1/completions",
+            data=json.dumps({"prompt": "x", "stream": True,
+                             "max_tokens": 8, "seed": 3}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            raw = r.read().decode()
+        events = [ln for ln in raw.splitlines() if ln.startswith("data: ")]
+        text = ""
+        ids = []
+        for ev in events:
+            if ev == "data: [DONE]":
+                continue
+            obj = json.loads(ev[len("data: "):])
+            for c in obj.get("choices", []):
+                text += c.get("text") or ""
+                ids.extend(c.get("token_ids") or [])
+        assert text == "abcd"
+        assert ids == [7, 9, 11, 13]
+        assert events[-1] == "data: [DONE]"
+        # the continuation body replica B received
+        assert got_body["resume_token_ids"] == [7, 9]
+        assert got_body["resume_text_chars"] == 2
+        assert got_body["max_tokens"] == 6          # 8 minus 2 relayed
+        assert got_body["seed"] == 3                # sampling params intact
+        m = RouterHandler.metrics
+        assert m.stream_failovers.total() == 1
+        assert addrs[0] in RouterHandler.pool.cooling()   # dead-marked
+    finally:
+        router.shutdown()
+        for b in (b1, b2):
+            b.shutdown()
+        RouterHandler.pool, RouterHandler.metrics = old
+
+
+def test_untagged_stream_death_still_truncates():
+    """A backend that streams WITHOUT token_ids (pre-r8 dialect) cannot be
+    continued once content was relayed: the router truncates (no spliced
+    second response) — the pre-r8 behavior, now explicit."""
+    import os as _os
+    import socket as _socket
+    import struct as _struct
+
+    class UntaggedDies(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.end_headers()
+            self.wfile.write(b'data: {"choices":[{"text":"a"}]}\n\n')
+            self.wfile.flush()
+            self.connection.setsockopt(_socket.SOL_SOCKET,
+                                       _socket.SO_LINGER,
+                                       _struct.pack("ii", 1, 0))
+            _os.close(self.connection.detach())
+
+    b1 = ThreadingHTTPServer(("127.0.0.1", 0), UntaggedDies)
+    b2 = ThreadingHTTPServer(("127.0.0.1", 0), FakeEngine)
+    for b in (b1, b2):
+        threading.Thread(target=b.serve_forever, daemon=True).start()
+    addrs = [f"127.0.0.1:{b1.server_port}", f"127.0.0.1:{b2.server_port}"]
+    router, old = _router_with(_fixed_order_pool(addrs))
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.server_port}/v1/completions",
+            data=json.dumps({"prompt": "x", "stream": True,
+                             "max_tokens": 8}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                raw = r.read().decode(errors="replace")
+        except (urllib.error.HTTPError, ConnectionError, OSError):
+            raw = ""
+        assert "[DONE]" not in raw
+        assert raw.count("HTTP/1.1") == 0
+        assert RouterHandler.metrics.stream_failovers.total() == 0
+    finally:
+        router.shutdown()
+        for b in (b1, b2):
+            b.shutdown()
+        RouterHandler.pool, RouterHandler.metrics = old

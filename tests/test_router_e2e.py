@@ -159,6 +159,187 @@ class DyingStreamBackend(BaseHTTPRequestHandler):
         _os.close(self.connection.detach())   # die mid-stream (RST now)
 
 
+def _fresh_stack(ports, cooldown_s=5.0, poll_s=0.2):
+    """Standalone stack (own replicas + router) for tests that kill or
+    drain replicas — the module fixture's replicas must stay intact."""
+    engines = [_start_engine_state(p) for p in ports]
+    addrs = ",".join(f"127.0.0.1:{p}" for p in ports)
+    old = RouterHandler.pool, RouterHandler.metrics
+    RouterHandler.pool = BackendPool(addrs, cooldown_s=cooldown_s)
+    RouterHandler.metrics = RouterMetrics()
+    poll_stop = threading.Event()
+    start_load_poller(RouterHandler.pool, interval_s=poll_s, stop=poll_stop)
+    router = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+
+    def teardown():
+        poll_stop.set()
+        router.shutdown()
+        for _, stop in engines:
+            stop.set()
+        RouterHandler.pool, RouterHandler.metrics = old
+
+    return router, engines, teardown
+
+
+def _start_engine_state(port):
+    """Like _start_engine but also returns the ServerState (the chaos tests
+    assert SchedulerStats slot accounting on the live engines)."""
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    serving = ServingConfig(weights_dtype="bf16", model=MODEL_NAME,
+                            max_decode_slots=4,
+                            max_cache_len=128, prefill_buckets=(16, 32, 64),
+                            dtype="float32")
+    state = build_state(serving, model_cfg=cfg, params=params, tokenizer=tok)
+    ready, stop = threading.Event(), threading.Event()
+    t = threading.Thread(target=serve,
+                         args=(state, "127.0.0.1", port, ready, stop),
+                         daemon=True)
+    t.start()
+    assert ready.wait(30)
+    return state, stop
+
+
+def _collect_stream(rurl, payload):
+    """POST a streaming completion; return (token_ids, text, finish, done)
+    reassembled from the SSE events."""
+    req = urllib.request.Request(
+        rurl + "/v1/completions", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    ids, text, fin, done = [], "", None, False
+    with urllib.request.urlopen(req, timeout=120) as r:
+        raw = r.read().decode()
+    for line in raw.splitlines():
+        if line == "data: [DONE]":
+            done = True
+            continue
+        if not line.startswith("data: "):
+            continue
+        obj = json.loads(line[len("data: "):])
+        for c in obj.get("choices", []):
+            ids.extend(c.get("token_ids") or [])
+            text += c.get("text") or ""
+            if c.get("finish_reason"):
+                fin = c["finish_reason"]
+    return ids, text, fin, done
+
+
+def test_replica_kill_mid_stream_failover_is_byte_identical():
+    """The ROADMAP's replica-kill-mid-stream-under-load scenario: kill a
+    replica after K streamed chunks while concurrent seeded streams run
+    through the router. EVERY client stream must complete with token ids
+    and text byte-identical to an undisturbed seeded run (the router
+    re-issues the dying stream as a deterministic continuation —
+    engine.py's cross-resume seed contract), with exactly one
+    tpu_router_stream_failovers_total and clean slot accounting on both
+    engines (no request double-finished)."""
+    import time
+
+    from aws_k8s_ansible_provisioner_tpu.serving import chaos
+
+    router, engines, teardown = _fresh_stack((18240, 18241))
+    rurl = f"http://127.0.0.1:{router.server_port}"
+    N = 4
+
+    def payload(i):
+        return {"model": MODEL_NAME, "prompt": f"kill scenario prompt {i}",
+                "max_tokens": 20, "stream": True, "seed": 1000 + i,
+                "temperature": 0.7, "ignore_eos": True}
+
+    def run_all(out):
+        ts = []
+        for i in range(N):
+            t = threading.Thread(
+                target=lambda i=i: out.__setitem__(
+                    i, _collect_stream(rurl, payload(i))))
+            t.start()
+            ts.append(t)
+        for t in ts:
+            t.join(timeout=120)
+
+    try:
+        ref = {}
+        run_all(ref)                       # undisturbed seeded reference
+        for i in range(N):
+            assert len(ref[i][0]) == 20 and ref[i][3], ref[i]
+
+        chaos.reset()
+        chaos.kill_replica_after_chunks(5, times=1)
+        got = {}
+        run_all(got)
+        assert chaos.get().stats()["kill_stream"]["fired"] == 1
+        for i in range(N):
+            assert got[i][0] == ref[i][0], f"stream {i} token ids diverged"
+            assert got[i][1] == ref[i][1], f"stream {i} text diverged"
+            assert got[i][3], f"stream {i} missing [DONE]"
+        assert RouterHandler.metrics.stream_failovers.total() == 1
+        # no request double-finished: every slot released exactly once —
+        # both engines quiesce to zero active slots and empty queues
+        time.sleep(0.3)
+        for state, _ in engines:
+            st = state.engine.sched.stats()
+            assert st.active_slots == 0 and st.queue_depth == 0, st
+    finally:
+        chaos.reset()
+        teardown()
+
+
+def test_drained_replica_leaves_and_reenters_rotation():
+    """POST /admin/drain (exit:false) removes a replica from the router's
+    rotation within one poll interval WITHOUT dead-marking it; new requests
+    route to the survivor; /admin/undrain returns it within one poll. A
+    drained-then-restarted replica re-enters the same way."""
+    import time
+
+    router, engines, teardown = _fresh_stack((18242, 18243), poll_s=0.15)
+    rurl = f"http://127.0.0.1:{router.server_port}"
+    drain_addr = "127.0.0.1:18242"
+    try:
+        # rotation-removal drain on replica 0 (exit:false keeps it alive)
+        req = urllib.request.Request(
+            "http://127.0.0.1:18242/admin/drain",
+            data=json.dumps({"exit": False}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["status"] == "draining"
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if drain_addr in RouterHandler.pool.draining():
+                break
+            time.sleep(0.05)
+        assert drain_addr in RouterHandler.pool.draining()
+        assert drain_addr not in RouterHandler.pool.cooling()   # not dead
+        assert drain_addr not in RouterHandler.pool.pick()
+        # traffic still serves (survivor), even direct-to-drained re-routes
+        for q in range(3):
+            req = urllib.request.Request(
+                rurl + "/v1/completions",
+                data=json.dumps({"model": MODEL_NAME, "prompt": f"d{q}",
+                                 "max_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert json.loads(r.read())["object"] == "text_completion"
+        assert RouterHandler.metrics.dead_marks.total() == 0
+        # undrain = the "drained replica restarted" transition: back in
+        # rotation within one poll interval
+        req = urllib.request.Request(
+            "http://127.0.0.1:18242/admin/undrain", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if drain_addr not in RouterHandler.pool.draining():
+                break
+            time.sleep(0.05)
+        assert drain_addr not in RouterHandler.pool.draining()
+        assert drain_addr in RouterHandler.pool.pick()
+    finally:
+        teardown()
+
+
 def test_mid_stream_backend_death_truncates_cleanly():
     """A backend dying MID-STREAM must yield a truncated SSE body (no
     [DONE], no spliced second response), mark the replica dead, and the
