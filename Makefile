@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests \
-	overload-smoke
+	overload-smoke resume-smoke reconcile-smoke
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -38,6 +38,27 @@ chaos-smoke:
 overload-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench_sweep.py --overload \
 		--overload-requests 24 --overload-levels 1,4,16
+
+# Self-healing deploy smoke (r9): kill a hermetic rehearse-style deploy
+# mid-L3 with injected FATAL chaos -> the journal classifies the failure and
+# `deploy --resume` completes from exactly that layer (L1/L2 not re-run);
+# inject TRANSIENT chaos into L2 -> the executor retries with deterministic
+# capped jittered exponential backoff and the deploy succeeds. Tier-1 runs
+# these tests too (marker resume_smoke); this is the focused driver.
+resume-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m resume_smoke \
+		-p no:cacheprovider
+
+# Reconciler smoke (r9): per-layer health probes (VM READY / nodes Ready /
+# per-replica /readyz / gateway smoke / collector), first-broken repair
+# (in-place undrain before playbook re-run, honest non-zero exit when the
+# probe still fails), and the rolling-restart-under-load scenario — every
+# serving replica restarted behind the real router under live seeded load,
+# zero non-2xx and byte-identical streams. Tier-1 runs these too (marker
+# reconcile_smoke).
+reconcile-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m reconcile_smoke \
+		-p no:cacheprovider
 
 # kubeconform (when installed) + structural validation over every rendered
 # deploy/manifests template; rehearse-kind.sh runs the same validator on the

@@ -825,7 +825,8 @@ class FrameworkConfig:
     deploy: DeployConfig = field(default_factory=DeployConfig)
 
 
-def ansible_vars(cfg: FrameworkConfig | None = None) -> str:
+def ansible_vars(cfg: FrameworkConfig | None = None,
+                 overrides: dict | None = None) -> str:
     """Render DeployConfig (+ shared serving values) as YAML for ansible extra-vars."""
     cfg = cfg or FrameworkConfig()
     d = dataclasses.asdict(cfg.deploy)
@@ -850,6 +851,9 @@ def ansible_vars(cfg: FrameworkConfig | None = None) -> str:
     # Replica lifecycle (r8): the preStop hook, terminationGracePeriodSeconds
     # and the engine's --drain-timeout all derive from this one knob.
     d["serving_drain_timeout_s"] = cfg.serving.drain_timeout_s
+    # --set overrides (rehearsals pin model/ports); unknown keys pass
+    # through — the playbooks treat group_vars as an open namespace
+    d.update(overrides or {})
     lines = ["# generated by aws_k8s_ansible_provisioner_tpu.config — do not edit"]
     for k, v in d.items():
         lines.append(f"{k}: {json.dumps(v)}")
@@ -882,18 +886,18 @@ if __name__ == "__main__":
                         "vars (the kind rehearsal uses this — the SAME "
                         "single config source the playbooks consume)")
     p.add_argument("--set", action="append", default=[], metavar="K=V",
-                   help="override a var for --render-manifest")
+                   help="override a var for --render-manifest/--ansible-vars")
     args = p.parse_args()
+    overrides = {}
+    for kv in args.set:
+        k, _, v = kv.partition("=")
+        try:
+            overrides[k] = json.loads(v)
+        except (ValueError, TypeError):
+            overrides[k] = v
     if args.render_manifest:
-        overrides = {}
-        for kv in args.set:
-            k, _, v = kv.partition("=")
-            try:
-                overrides[k] = json.loads(v)
-            except (ValueError, TypeError):
-                overrides[k] = v
         print(render_manifest(args.render_manifest, **overrides))
     elif args.ansible_vars:
-        print(ansible_vars(), end="")
+        print(ansible_vars(overrides=overrides), end="")
     else:
         print(json.dumps(dataclasses.asdict(FrameworkConfig()), indent=2, default=str))

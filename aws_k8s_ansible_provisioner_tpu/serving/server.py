@@ -313,6 +313,13 @@ class Handler(BaseHTTPRequestHandler):
                 "uptime_s": _now() - self.state.started,
                 "active_requests": len(eng._active_slots()),
                 "queue_depth": len(eng.pending),
+                # /v1 requests inside a handler thread (parse/tokenize/
+                # stream-out) are invisible to the two engine counters
+                # above; external drain orchestration (deploy/probes.py
+                # rolling_restart) needs the same inflight==0 signal the
+                # in-process drain watcher uses before it may kill the
+                # process
+                "inflight": self.state.inflight,
                 "stalled_for_s": round(stalled, 1) or None,
                 "last_error": eng.last_error or None,
                 # the autotuned decode batch-block (ISSUE r6): operators can

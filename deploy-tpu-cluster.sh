@@ -2,14 +2,11 @@
 # L0 CLI orchestrator for the TPU-native serving stack.
 #
 # Behavioral contract mirrors the reference CLI (reference deploy-k8s-cluster.sh:93-117):
-#   - subcommand dispatch: deploy | cleanup | -h/--help, default = deploy
-#   - sequences the five layers L1..L5 as ansible-playbook invocations
-#   - hands the generated inventory file from L1 to L2..L5 (newest-wins discovery,
-#     reference deploy-k8s-cluster.sh:23)
+#   - subcommand dispatch: deploy | cleanup | reconcile | -h/--help, default = deploy
+#   - sequences the five layers L1..L5 as playbook invocations
+#   - hands the generated inventory file from L1 to L2..L5
 #   - prints a connection summary parsed from the details file at the end
 #     (reference deploy-k8s-cluster.sh:50-74)
-#   - fail-fast, no rollback: a half-built TPU VM keeps running until `cleanup`
-#     (reference deploy-k8s-cluster.sh:3 `set -e` semantics)
 #
 # TPU-first deltas (not a translation):
 #   - ALL shared values come from one source: the Python config module emits
@@ -17,25 +14,52 @@
 #     its layers by duplicated literals (SURVEY.md §1 "Key structural fact");
 #     here a playbook never hard-codes a version, namespace, or model id.
 #   - provisioning targets GCP TPU VMs (gcloud) instead of AWS EC2 (boto3).
+#   - the reference was `set -e` fail-fast with no rollback: a transient
+#     gcloud error in L2 stranded a half-built (billing) TPU VM. This
+#     orchestrator is a CHECKPOINTED STATE MACHINE instead: every layer run
+#     is journaled to tpu-deploy-state-<epoch>.json (deploy/state.py) with a
+#     playbook+group_vars fingerprint and the classified failure reason, so
+#       deploy --resume   re-runs from the first failed/stale layer only
+#       reconcile         probes each layer's ACTUAL health (deploy/probes.py)
+#                         and repairs just the broken one
+#   - runs playbooks through ansible-playbook when installed, else through
+#     the in-repo executor deploy/miniansible.py (same YAML, no external
+#     ansible dependency — the executor adds transient/fatal failure
+#     classification and capped jittered exponential backoff).
+#
+# Environment knobs:
+#   TPU_DEPLOY_VARS="k=v k=v"   extra --set overrides for group_vars generation
+#   PYTHON                      python interpreter (default python3)
 set -euo pipefail
 
 SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
 DEPLOY_DIR="${SCRIPT_DIR}/deploy"
 PYTHON="${PYTHON:-python3}"
+STATE=""            # journal file for this run (set by deploy/reconcile)
+TASK_JOURNAL=""     # miniansible per-task journal next to $STATE
+RESUME=0
 
 usage() {
     cat <<'EOF'
-Usage: ./deploy-tpu-cluster.sh [deploy|cleanup|-h|--help]
+Usage: ./deploy-tpu-cluster.sh [deploy [--resume]|cleanup|reconcile|-h|--help]
 
-  deploy    Provision a GCP TPU VM, install a single-node Kubernetes cluster
-            (CRI-O + Flannel + TPU device plugin), deploy the JAX serving
-            engine behind an inference gateway, smoke-test the OpenAI API,
-            and stand up the OTEL observability stack.  (default)
-  cleanup   Delete every TPU VM recorded in tpu-inventory-*.ini and remove
-            the generated local state files.
+  deploy     Provision a GCP TPU VM, install a single-node Kubernetes cluster
+             (CRI-O + Flannel + TPU device plugin), deploy the JAX serving
+             engine behind an inference gateway, smoke-test the OpenAI API,
+             and stand up the OTEL observability stack.  (default)
+             Every layer is checkpointed to tpu-deploy-state-*.json.
+    --resume Skip layers already `ok` with an unchanged playbook+group_vars
+             fingerprint; re-run from the first failed/stale layer.
+  reconcile  Probe each layer's live health (VM READY, nodes Ready, /readyz
+             per replica, gateway smoke, collector) and repair ONLY the
+             first broken layer: cheap in-place fixes first (e.g. undrain a
+             stuck replica), else re-run that layer's playbook.
+  cleanup    Delete every TPU VM recorded in tpu-inventory-*.ini; tolerant
+             of already-deleted VMs, keeps the inventory of any VM whose
+             deletion FAILED (no orphans), journals the outcome per VM.
 
 Prerequisites: gcloud authenticated (gcloud auth login + application-default),
-ansible-playbook on PATH, HF token at ~/.cache/huggingface/token.
+python3; ansible-playbook optional (deploy/miniansible.py is the fallback).
 EOF
 }
 
@@ -43,53 +67,180 @@ generate_group_vars() {
     # Single config source: every value the playbooks share with the engine is
     # emitted here, once (replaces the reference's per-playbook vars blocks).
     mkdir -p "${DEPLOY_DIR}/group_vars"
+    local sets=()
+    for kv in ${TPU_DEPLOY_VARS:-}; do
+        sets+=(--set "$kv")
+    done
     "${PYTHON}" -m aws_k8s_ansible_provisioner_tpu.config --ansible-vars \
-        > "${DEPLOY_DIR}/group_vars/all.yaml"
+        ${sets[@]+"${sets[@]}"} > "${DEPLOY_DIR}/group_vars/all.yaml"
     echo "Wrote ${DEPLOY_DIR}/group_vars/all.yaml (single-source deploy vars)"
 }
 
-newest_inventory() {
-    # Newest-wins inventory discovery (contract from reference deploy-k8s-cluster.sh:23).
-    ls -rt "${SCRIPT_DIR}"/tpu-inventory-*.ini 2>/dev/null | tail -1
+newest() {
+    # Deterministic newest-wins discovery: deploy/state.py sorts on
+    # (mtime_ns, name), replacing the fragile shell mtime-sort whose
+    # equal-mtime ordering depended on the filesystem.
+    "${PYTHON}" "${DEPLOY_DIR}/state.py" newest "$1" --root "${SCRIPT_DIR}"
 }
 
-deploy_cluster() {
-    echo "=== TPU cluster deploy: L1 provision → L2 cluster → L3 serving → L4 test → L5 observability ==="
-    generate_group_vars
+newest_inventory() { newest 'tpu-inventory-*.ini'; }
 
-    echo "--- [L1] Launching TPU VM ---"
-    ansible-playbook "${DEPLOY_DIR}/launch-tpu-vm.yaml"
+play() {
+    # One playbook run: real ansible when installed, else the in-repo
+    # executor (which also writes the classified per-task journal).
+    if command -v ansible-playbook >/dev/null 2>&1; then
+        ansible-playbook "$@"
+    else
+        "${PYTHON}" "${DEPLOY_DIR}/miniansible.py" \
+            ${TASK_JOURNAL:+--journal "${TASK_JOURNAL}"} "$@"
+    fi
+}
 
+state_py() { "${PYTHON}" "${DEPLOY_DIR}/state.py" "$@"; }
+
+open_state() {
+    # --resume continues the newest journal; a fresh deploy starts its own.
+    if [[ "${RESUME}" == 1 ]]; then
+        STATE="$(newest 'tpu-deploy-state-*.json')"
+        if [[ -z "${STATE}" ]]; then
+            echo "NOTE: --resume but no tpu-deploy-state-*.json found —" \
+                 "starting a fresh run" >&2
+            RESUME=0
+        fi
+    fi
+    if [[ -z "${STATE}" ]]; then
+        STATE="${SCRIPT_DIR}/tpu-deploy-state-$(date +%s).json"
+    fi
+    TASK_JOURNAL="${STATE%.json}.tasks.jsonl"
+    state_py init --state "${STATE}"
+    echo "Deploy journal: ${STATE}"
+}
+
+run_layer() {
+    # run_layer <L#> <playbook-args...> — the checkpointed state machine
+    # step: skip `ok`+fingerprint-matched layers on --resume, otherwise
+    # journal running -> ok/failed (failed carries the classified
+    # transient/fatal reason from the task journal).
+    local layer="$1"; shift
+    local fp
+    fp="$(state_py fingerprint "${layer}" --deploy-dir "${DEPLOY_DIR}")"
+    if [[ "${RESUME}" == 1 ]] && \
+            state_py should-skip "${layer}" --state "${STATE}" --fingerprint "${fp}"; then
+        echo "--- [${layer}] checkpointed ok (fingerprint unchanged) — skipping ---"
+        return 0
+    fi
+    state_py begin "${layer}" --state "${STATE}" --fingerprint "${fp}"
+    local rc=0
+    play "$@" || rc=$?
+    if [[ ${rc} -eq 0 ]]; then
+        state_py finish "${layer}" --state "${STATE}" --status ok
+    else
+        state_py finish "${layer}" --state "${STATE}" --status failed \
+            --reason "playbook exited ${rc}" \
+            ${TASK_JOURNAL:+--from-journal "${TASK_JOURNAL}"}
+        echo "" >&2
+        echo "ERROR: [${layer}] failed — journal: ${STATE}" >&2
+        state_py show --state "${STATE}" >&2 || true
+        echo "Fix the cause (transient errors were already retried with" \
+             "backoff), then: $0 deploy --resume" >&2
+        exit "${rc}"
+    fi
+}
+
+require_inventory() {
     local inv
     inv="$(newest_inventory)"
     if [[ -z "${inv}" ]]; then
         echo "ERROR: no tpu-inventory-*.ini produced by launch-tpu-vm.yaml" >&2
         exit 1
     fi
+    echo "${inv}"
+}
+
+deploy_cluster() {
+    echo "=== TPU cluster deploy: L1 provision → L2 cluster → L3 serving → L4 test → L5 observability ==="
+    generate_group_vars
+    open_state
+
+    echo "--- [L1] Launching TPU VM ---"
+    run_layer L1 "${DEPLOY_DIR}/launch-tpu-vm.yaml"
+
+    local inv
+    inv="$(require_inventory)"
     echo "Using inventory: ${inv}"
 
     echo "--- [L2] Bootstrapping single-node Kubernetes (CRI-O + Flannel + TPU plugin) ---"
-    ansible-playbook -i "${inv}" "${DEPLOY_DIR}/kubernetes-single-node.yaml"
+    run_layer L2 -i "${inv}" "${DEPLOY_DIR}/kubernetes-single-node.yaml"
 
     echo "--- [L3] Deploying JAX serving engine + inference gateway ---"
-    ansible-playbook -i "${inv}" "${DEPLOY_DIR}/serving-deploy.yaml"
+    run_layer L3 -i "${inv}" "${DEPLOY_DIR}/serving-deploy.yaml"
 
     echo "--- [L4] Smoke-testing the OpenAI API through the gateway ---"
-    ansible-playbook -i "${inv}" "${DEPLOY_DIR}/serving-test.yaml"
+    run_layer L4 -i "${inv}" "${DEPLOY_DIR}/serving-test.yaml"
 
     echo "--- [L5] Installing OTEL observability stack ---"
-    ansible-playbook -i "${inv}" "${DEPLOY_DIR}/otel-observability-setup.yaml"
+    run_layer L5 -i "${inv}" "${DEPLOY_DIR}/otel-observability-setup.yaml"
 
     print_summary
+}
+
+reconcile_cluster() {
+    echo "=== TPU cluster reconcile: probe L1..L5, repair the first broken layer ==="
+    generate_group_vars
+    local inv broken
+    inv="$(newest_inventory)"
+    STATE="$(newest 'tpu-deploy-state-*.json')"
+    [[ -z "${STATE}" ]] && STATE="${SCRIPT_DIR}/tpu-deploy-state-$(date +%s).json"
+    TASK_JOURNAL="${STATE%.json}.tasks.jsonl"
+    state_py init --state "${STATE}"
+
+    broken="$("${PYTHON}" "${DEPLOY_DIR}/probes.py" --first-broken \
+        ${inv:+--inventory "${inv}"})"
+    if [[ -z "${broken}" || "${broken}" == "none" ]]; then
+        echo "All layers healthy — nothing to reconcile."
+        return 0
+    fi
+    echo "--- reconcile: ${broken} unhealthy ---"
+    "${PYTHON}" "${DEPLOY_DIR}/probes.py" ${inv:+--inventory "${inv}"} || true
+
+    if [[ "${broken}" == "L3" ]]; then
+        # cheap repair first: an alive-but-draining replica (stuck drain)
+        # is undrained in place — no playbook re-run, no pod churn
+        if "${PYTHON}" "${DEPLOY_DIR}/probes.py" --repair-undrain \
+                ${inv:+--inventory "${inv}"}; then
+            echo "reconcile: L3 repaired in place (undrain)"
+            return 0
+        fi
+    fi
+
+    echo "--- reconcile: re-running ${broken} playbook ---"
+    case "${broken}" in
+        L1) run_layer L1 "${DEPLOY_DIR}/launch-tpu-vm.yaml"
+            inv="$(require_inventory)" ;;
+        L2) run_layer L2 -i "${inv}" "${DEPLOY_DIR}/kubernetes-single-node.yaml" ;;
+        L3) run_layer L3 -i "${inv}" "${DEPLOY_DIR}/serving-deploy.yaml" ;;
+        L4) run_layer L4 -i "${inv}" "${DEPLOY_DIR}/serving-test.yaml" ;;
+        L5) run_layer L5 -i "${inv}" "${DEPLOY_DIR}/otel-observability-setup.yaml" ;;
+    esac
+
+    if "${PYTHON}" "${DEPLOY_DIR}/probes.py" --layer "${broken}" \
+            ${inv:+--inventory "${inv}"}; then
+        echo "reconcile: ${broken} healthy after repair"
+    else
+        echo "reconcile: ${broken} STILL unhealthy after re-running its" \
+             "playbook — see ${STATE}" >&2
+        exit 1
+    fi
 }
 
 print_summary() {
     # Parse the newest details file for the human-facing summary
     # (reference deploy-k8s-cluster.sh:50-74 behavior).
     local details
-    details="$(ls -rt "${SCRIPT_DIR}"/tpu-instance-*-details.txt 2>/dev/null | tail -1)"
+    details="$(newest 'tpu-instance-*-details.txt')"
     echo ""
     echo "=== Deployment complete ==="
+    state_py show --state "${STATE}" || true
     if [[ -n "${details}" ]]; then
         local name zone ip
         name="$(grep -E '^tpu_name=' "${details}" | cut -d= -f2- || true)"
@@ -113,18 +264,27 @@ cleanup_instances() {
         exit 0
     fi
     generate_group_vars
-    ansible-playbook "${DEPLOY_DIR}/cleanup-tpu-vm.yaml"
+    play "${DEPLOY_DIR}/cleanup-tpu-vm.yaml"
 }
 
 case "${1:-deploy}" in
     deploy)
-        if [[ $# -gt 1 ]]; then
-            echo "ERROR: deploy takes no extra arguments" >&2; usage; exit 1
+        shift || true
+        if [[ "${1:-}" == "--resume" ]]; then
+            RESUME=1
+            shift
+        fi
+        if [[ $# -gt 0 ]]; then
+            echo "ERROR: deploy takes no extra arguments (except --resume)" >&2
+            usage; exit 1
         fi
         deploy_cluster
         ;;
     cleanup)
         cleanup_instances
+        ;;
+    reconcile)
+        reconcile_cluster
         ;;
     -h|--help)
         usage

@@ -33,6 +33,7 @@ from __future__ import annotations
 import argparse
 import base64
 import glob as globmod
+import hashlib
 import json
 import os
 import re
@@ -42,7 +43,7 @@ import stat as statmod
 import subprocess
 import sys
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jinja2
 import yaml
@@ -51,9 +52,110 @@ import yaml
 DELAY_SCALE = float(os.environ.get("MINI_ANSIBLE_DELAY_SCALE", "1.0"))
 # host-provisioning modules become journaled no-ops in rehearsal mode
 REHEARSAL = os.environ.get("MINI_ANSIBLE_REHEARSAL", "1") != "0"
+# transient-classified failures on tasks WITHOUT an explicit `retries` still
+# get this many backoff retries (a flaky apt mirror should not abort L2)
+TRANSIENT_RETRIES = int(os.environ.get("MINI_ANSIBLE_TRANSIENT_RETRIES", "2"))
+# exponential backoff ceiling in (pre-DELAY_SCALE) seconds
+BACKOFF_CAP = float(os.environ.get("MINI_ANSIBLE_BACKOFF_CAP", "60"))
 
 SYSTEM_MODULES = {"apt", "apt_repository", "systemd", "modprobe",
                   "dpkg_selections", "get_url", "sysctl"}
+
+# ---------------------------------------------------------------------------
+# Failure classification (transient = worth retrying/resuming, fatal = a
+# config/auth/logic error no retry will fix). The table drives both the
+# in-run backoff policy and the journal record deploy-tpu-cluster.sh's
+# resume/reconcile machinery reads.
+# ---------------------------------------------------------------------------
+
+# retryable exit codes: curl DNS/connect/timeout/TLS/empty-reply/recv
+# (6/7/28/35/52/56), apt's transient-failure convention (100), and
+# timeout(1)'s kill code (124)
+TRANSIENT_RC = {6, 7, 28, 35, 52, 56, 100, 124}
+
+TRANSIENT_PATTERNS = [
+    r"(?i)\btimed?[ -]?out\b",
+    r"(?i)\btimeout\b",
+    r"(?i)connection (refused|reset|closed|aborted)",
+    r"(?i)temporar(il)?y (unavailable|failure|unreachable)",
+    r"(?i)could not resolve",
+    r"(?i)name (or service not known|resolution)",
+    r"(?i)quota.{0,40}exceeded",
+    r"RESOURCE_EXHAUSTED",
+    r"(?i)rate.?limit",
+    r"(?i)\bHTTP(/[0-9.]+)? (429|500|502|503|504)\b",
+    r"(?i)service unavailable",
+    r"(?i)\bunreachable\b",
+    r"(?i)stockout|out of capacity|insufficient capacity",
+    r"(?i)lock(ed)? .{0,40}(held|another process|unavailable)",
+    r"(?i)/var/lib/(dpkg|apt)/lock",
+    r"(?i)TLS handshake",
+    r"(?i)EOF occurred in violation of protocol",
+]
+
+
+def classify_failure(res: dict) -> Tuple[str, str]:
+    """Tag a failed module result ``transient`` or ``fatal``.
+
+    Pattern match beats rc: a gcloud quota error exits 1 but is transient;
+    an `assert` failure has no rc but is fatal. Anything unrecognized is
+    fatal — retrying an unknown error hides it."""
+    text = " ".join(str(res.get(k) or "")
+                    for k in ("msg", "stderr", "stdout"))
+    for pat in TRANSIENT_PATTERNS:
+        m = re.search(pat, text)
+        if m:
+            return "transient", f"matched {m.group(0)!r}"
+    rc = res.get("rc")
+    if rc in TRANSIENT_RC:
+        return "transient", f"retryable rc {rc}"
+    reason = str(res.get("msg") or "").strip()
+    if not reason:
+        err = str(res.get("stderr") or "").strip().splitlines()
+        reason = err[-1] if err else f"rc {rc}"
+    return "fatal", reason[:300]
+
+
+def backoff_schedule(base: float, attempts: int, seed: str = "",
+                     cap: float = None) -> List[float]:
+    """Capped jittered exponential backoff, DETERMINISTIC per (seed, slot):
+    jitter is +/-25% derived from sha256, never from a clock or RNG, so a
+    rehearsal run (and its tests) see the exact same schedule every time.
+    Values are pre-DELAY_SCALE seconds; the sleeper applies the knob."""
+    cap = BACKOFF_CAP if cap is None else cap
+    out = []
+    for i in range(max(0, attempts)):
+        d = min(base * (2.0 ** i), cap)
+        h = int(hashlib.sha256(f"{seed}:{i}".encode()).hexdigest()[:8], 16)
+        out.append(round(d * (0.75 + 0.5 * (h / 0xFFFFFFFF)), 4))
+    return out
+
+
+class _ChaosSpec:
+    __slots__ = ("pattern", "kind", "times", "fired")
+
+    def __init__(self, pattern: str, kind: str, times: int = 1):
+        self.pattern, self.kind, self.times = pattern, kind, times
+        self.fired = 0
+
+
+def parse_chaos(spec: str) -> List[_ChaosSpec]:
+    """MINI_ANSIBLE_CHAOS='<task-substr>:transient|fatal[:times];...' —
+    deterministic module-failure injection for the self-healing tests: a
+    matching task's next ``times`` executions return a synthetic failed
+    result of the given class instead of running the module."""
+    out = []
+    for entry in (spec or "").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) < 2 or parts[1] not in ("transient", "fatal"):
+            raise ValueError(f"bad MINI_ANSIBLE_CHAOS entry {entry!r} "
+                             "(want pattern:transient|fatal[:times])")
+        times = int(parts[2]) if len(parts) > 2 else 1
+        out.append(_ChaosSpec(parts[0], parts[1], times))
+    return out
 
 
 class TaskFailed(Exception):
@@ -121,6 +223,7 @@ def make_env() -> jinja2.Environment:
             str(v).encode()).decode(),
         "random": _f_random,
         "split": lambda v, sep=None: str(v).split(sep),
+        "zip": lambda a, *o: [list(t) for t in zip(a, *o)],
     })
     def _t_success(v):
         return isinstance(v, dict) and not v.get("failed")
@@ -309,6 +412,26 @@ class Runner:
         # MINI_ANSIBLE_RECORD=<path> additionally streams them as JSONL.
         self.recorded: List[dict] = []
         self.record_path = os.environ.get("MINI_ANSIBLE_RECORD", "")
+        # deterministic fault injection (self-healing chaos tests)
+        self.chaos = parse_chaos(os.environ.get("MINI_ANSIBLE_CHAOS", ""))
+
+    def chaos_fire(self, tname: str) -> Optional[dict]:
+        """Consume one injected failure for a matching task, if armed."""
+        for spec in self.chaos:
+            if spec.pattern.lower() in str(tname).lower() \
+                    and spec.fired < spec.times:
+                spec.fired += 1
+                if spec.kind == "transient":
+                    res = _cmd_result(
+                        124, "", "chaos: injected transient failure: "
+                        "connection timed out")
+                else:
+                    res = _cmd_result(
+                        2, "", "chaos: injected fatal failure: "
+                        "invalid argument")
+                res["chaos"] = spec.kind
+                return res
+        return None
 
     # -- infrastructure ------------------------------------------------------
 
@@ -453,14 +576,34 @@ class Runner:
                     task_vars[index_var] = i
                 if not self.templar.truthy(task.get("when"), task_vars):
                     continue
-            results.append(self.run_single(task, module, short, tname,
-                                           task_vars, play_env))
-        res = results[-1] if len(results) == 1 else {
-            "results": results,
-            "changed": any(r.get("changed") for r in results),
-            "failed": any(r.get("failed") for r in results),
-        } if results else {"changed": False, "failed": False,
-                           "skipped": True}
+            r = self.run_single(task, module, short, tname, task_vars,
+                                play_env)
+            if item is not None:
+                # ansible attaches the loop item to its per-item result
+                # (`registered.results | map(attribute='item...')` patterns)
+                r.setdefault("item", item)
+            results.append(r)
+            if short == "set_fact":
+                # looped set_fact accumulates per iteration (ansible
+                # semantics — `x: "{{ x | default([]) + [item] }}"` patterns)
+                ctx.update(r.get("ansible_facts", {}))
+                task_vars.update(r.get("ansible_facts", {}))
+        if items is not None:
+            # ansible semantics: a looped task ALWAYS registers the
+            # aggregate {results: [...]}, even for one item (a single-VM
+            # cleanup previously registered the bare result, so
+            # `deletion.results` silently templated to an empty list)
+            res = {
+                "results": results,
+                "changed": any(r.get("changed") for r in results),
+                "failed": any(r.get("failed") for r in results),
+            }
+            if not results:
+                res["skipped"] = True
+        else:
+            res = results[-1] if results else {"changed": False,
+                                               "failed": False,
+                                               "skipped": True}
 
         if task.get("register"):
             ctx[task["register"]] = res
@@ -482,11 +625,23 @@ class Runner:
     def run_single(self, task, module, short, tname, task_vars,
                    play_env) -> dict:
         retries = int(task.get("retries", 0))
-        delay = float(task.get("delay", 5)) * DELAY_SCALE
+        base_delay = float(task.get("delay", 5))
         until = task.get("until")
-        attempts = retries if until else 1
-        attempts = max(1, attempts)
+        # until-loops poll for `retries` attempts (ansible semantics, flat
+        # delay between healthy polls); plain tasks get transient-failure
+        # retries — explicit `retries` if given, else the module default
+        if until is not None:
+            attempts = max(1, retries)
+        else:
+            attempts = 1 + (retries if "retries" in task
+                            else TRANSIENT_RETRIES)
+        backoffs = backoff_schedule(base_delay, attempts, seed=str(tname))
+        slept: List[float] = []
         res: dict = {}
+        satisfied = False
+        last_failure: Optional[Tuple[str, str]] = None
+        chaos_kind = None
+        attempt = 0
         for attempt in range(attempts):
             res = self.execute_module(task, module, short, tname, task_vars,
                                       play_env)
@@ -500,19 +655,53 @@ class Runner:
             if task.get("changed_when") is not None:
                 res["changed"] = self.templar.truthy(task["changed_when"],
                                                      probe)
-            if until is None or self.templar.truthy(until, probe):
+            failed = bool(res.get("failed"))
+            if failed:
+                cls, why = classify_failure(res)
+                res["failure_class"], res["failure_reason"] = cls, why
+                last_failure = (cls, why)
+            if res.get("chaos"):
+                chaos_kind = res["chaos"]
+            if until is not None:
+                satisfied = self.templar.truthy(until, probe)
+            else:
+                satisfied = not failed
+            if satisfied:
                 break
+            if failed and res.get("failure_class") == "fatal":
+                break       # fail fast: no retry fixes a fatal error
             if attempt < attempts - 1:
-                time.sleep(delay)
-        else:
+                # failures back off exponentially (capped, jittered,
+                # deterministic); healthy until-polls keep the flat delay
+                d = (backoffs[attempt] if failed else base_delay) \
+                    * DELAY_SCALE
+                slept.append(round(d, 4))
+                time.sleep(d)
+        if not satisfied and until is not None:
             res.setdefault("failed", True)
+            if res.get("failed") and "failure_class" not in res:
+                res["failure_class"], res["failure_reason"] = \
+                    "transient", f"until {until!r} unmet after " \
+                                 f"{attempts} attempts"
         flag = "failed" if res.get("failed") else \
             ("changed" if res.get("changed") else "ok")
-        print(f"TASK [{tname}] ... {flag}")
+        print(f"TASK [{tname}] ... {flag}"
+              + (f" (attempts={attempt + 1})" if attempt else ""))
         rec = {"task": tname, "module": short, "rc": res.get("rc"),
                "changed": res.get("changed", False),
                "failed": res.get("failed", False),
-               "cmd": res.get("cmd")}
+               "cmd": res.get("cmd"),
+               "attempts": attempt + 1}
+        if slept:
+            rec["backoff_s"] = slept
+        if last_failure is not None:
+            # classified even when the task RECOVERED (failed=False after a
+            # transient retry): the journal shows what was survived
+            rec["failure_class"], rec["failure_reason"] = \
+                res.get("failure_class", last_failure[0]), \
+                res.get("failure_reason", last_failure[1])
+        if chaos_kind:
+            rec["chaos"] = chaos_kind
         if "recorded" in res:
             # recording-assert mode: the host module's intended action,
             # untruncated (the 300-char "cmd" is for log readability only)
@@ -524,6 +713,24 @@ class Runner:
 
     def execute_module(self, task, module, short, tname, task_vars,
                        play_env) -> dict:
+        chaos = self.chaos_fire(tname)
+        if chaos is not None:
+            print(f"  chaos: injected {chaos['chaos']} failure "
+                  f"into [{tname}]")
+            return chaos
+        try:
+            return self._execute_module(task, module, short, tname,
+                                        task_vars, play_env)
+        except (TaskFailed, EndPlay):
+            raise
+        except OSError as e:
+            # a module hitting a missing file/dir is a FAILED RESULT (so
+            # failed_when/ignore_errors/classification apply), not a crash
+            return {"changed": False, "failed": True, "rc": None,
+                    "msg": f"{short}: {e}"}
+
+    def _execute_module(self, task, module, short, tname, task_vars,
+                        play_env) -> dict:
         raw_args = task[module]
         args = self.templar.render(raw_args, task_vars)
         margs = self.templar.render(task.get("args") or {}, task_vars)

@@ -1,0 +1,512 @@
+#!/usr/bin/env python3
+"""Per-layer health probes + repair drivers for ``deploy-tpu-cluster.sh
+reconcile``.
+
+The resumable journal (deploy/state.py) answers "which layer did the LAST
+RUN reach"; this module answers "which layer is broken NOW" — the
+difference is what makes the pipeline self-healing rather than merely
+restartable. Each layer has a cheap liveness probe:
+
+  L1  TPU VM exists and is READY (``gcloud ... describe``), inventory file
+      present
+  L2  every Kubernetes node reports Ready (kubectl on the head node, via
+      ``gcloud compute tpus tpu-vm ssh`` — the same transport the deploy
+      playbooks use; the rehearsal shims answer both)
+  L3  every serving replica answers ``GET /readyz`` with 200
+  L4  gateway smoke: ``GET /v1/models`` through the gateway lists the
+      served model id
+  L5  OTEL collector namespace answers (kubectl), or the override endpoint
+      responds
+
+``first_broken`` returns the FIRST unhealthy layer — repairing it is the
+reconciler's whole job (later layers are re-probed, not re-run, because a
+broken L2 usually explains the L4 symptom). For L3 there is a cheap
+repair that beats a playbook re-run: a replica alive-but-draining (a
+stuck or forgotten drain) is undrained in place.
+
+Also here: the rolling-restart driver the reconciler uses under
+rehearse-kind (ROADMAP "multi-replica drain chaos at scale") — drain a
+replica out of rotation, wait for it to quiesce, restart it, wait for
+/readyz, undrain, then the next replica — and the seeded load loop that
+asserts zero non-2xx and byte-identical streams while restarts happen.
+
+Env overrides (rehearsals and tests):
+  TPU_PROBE_REPLICAS    comma list of host:port replica addresses (L3)
+  REHEARSE_GW_ADDR      gateway host:port (L4)
+  TPU_PROBE_COLLECTOR   http URL probed instead of kubectl for L5
+  REHEARSE_ENGINE_IP    default replica host when kubectl lookup is empty
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+import yaml
+
+LAYERS = ("L1", "L2", "L3", "L4", "L5")
+DEPLOY_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+class ProbeResult:
+    def __init__(self, layer: str, ok: bool, detail: str):
+        self.layer, self.ok, self.detail = layer, ok, detail
+
+    def as_dict(self) -> dict:
+        return {"layer": self.layer, "ok": self.ok, "detail": self.detail}
+
+
+def load_group_vars(deploy_dir: str = DEPLOY_DIR) -> Dict:
+    for name in ("all.yaml", "all.yml"):
+        p = os.path.join(deploy_dir, "group_vars", name)
+        if os.path.exists(p):
+            with open(p) as f:
+                return yaml.safe_load(f) or {}
+    return {}
+
+
+def parse_inventory_vm(inventory: Optional[str]) -> Dict[str, str]:
+    """tpu_name / zone / project out of a generated tpu-inventory-*.ini
+    (same dual strategy as cleanup-tpu-vm.yaml: content first, filename
+    fallback)."""
+    out: Dict[str, str] = {}
+    if not inventory or not os.path.exists(inventory):
+        return out
+    text = open(inventory).read()
+    for key, pat in (("name", r"tpu_name=([A-Za-z0-9_-]+)"),
+                     ("zone", r"tpu_zone=([A-Za-z0-9-]+)"),
+                     ("project", r"tpu_project=([A-Za-z0-9_-]+)")):
+        m = re.search(pat, text)
+        if m:
+            out[key] = m.group(1)
+    if "name" not in out:
+        base = os.path.basename(inventory)
+        out["name"] = re.sub(r"^tpu-inventory-|\.ini$", "", base)
+    return out
+
+
+def _run(argv: List[str], timeout: float = 60.0) -> subprocess.CompletedProcess:
+    return subprocess.run(argv, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def _http_get(url: str, timeout: float = 10.0):
+    """(status, body) — HTTP errors return their status, transport errors
+    return (None, errstr)."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode(errors="replace")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(errors="replace")
+    except (OSError, ValueError) as e:
+        return None, str(e)
+
+
+def _http_post(url: str, payload: dict, timeout: float = 10.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode(errors="replace")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(errors="replace")
+    except (OSError, ValueError) as e:
+        return None, str(e)
+
+
+def node_shell(vm: Dict[str, str], gv: Dict, cmd: str,
+               timeout: float = 60.0) -> subprocess.CompletedProcess:
+    """Run a command on the head node over the same transport the deploy
+    layer uses (gcloud ssh; the rehearsal shim executes it locally)."""
+    return _run([
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", vm.get("name", ""),
+        "--zone", vm.get("zone", str(gv.get("gcp_zone", ""))),
+        "--project", vm.get("project", str(gv.get("gcp_project", ""))),
+        f"--command={cmd}",
+    ], timeout=timeout)
+
+
+# -- per-layer probes --------------------------------------------------------
+
+
+def probe_l1(gv: Dict, inventory: Optional[str]) -> ProbeResult:
+    if not inventory or not os.path.exists(inventory):
+        return ProbeResult("L1", False, "no tpu-inventory-*.ini")
+    vm = parse_inventory_vm(inventory)
+    try:
+        p = _run(["gcloud", "compute", "tpus", "tpu-vm", "describe",
+                  vm["name"],
+                  "--zone", vm.get("zone", str(gv.get("gcp_zone", ""))),
+                  "--project",
+                  vm.get("project", str(gv.get("gcp_project", ""))),
+                  "--format", "value(state)"])
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return ProbeResult("L1", False, f"gcloud describe failed: {e}")
+    state = p.stdout.strip().splitlines()[-1] if p.stdout.strip() else ""
+    ok = p.returncode == 0 and state == "READY"
+    return ProbeResult("L1", ok,
+                       f"vm {vm['name']} state={state or p.stderr.strip()}")
+
+
+def probe_l2(gv: Dict, inventory: Optional[str]) -> ProbeResult:
+    vm = parse_inventory_vm(inventory)
+    kubectl = "kubectl --kubeconfig /etc/kubernetes/admin.conf"
+    try:
+        p = node_shell(vm, gv, f"{kubectl} get nodes --no-headers")
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return ProbeResult("L2", False, f"kubectl unreachable: {e}")
+    if p.returncode != 0:
+        return ProbeResult("L2", False,
+                           f"kubectl get nodes rc={p.returncode}: "
+                           f"{p.stderr.strip()[:200]}")
+    lines = [ln for ln in p.stdout.splitlines() if ln.strip()]
+    if not lines:
+        return ProbeResult("L2", False, "no nodes registered")
+    not_ready = [ln.split()[0] for ln in lines
+                 if "NotReady" in ln or " Ready" not in " " + ln]
+    return ProbeResult("L2", not not_ready,
+                       f"{len(lines)} node(s), "
+                       + ("all Ready" if not not_ready
+                          else f"NotReady: {','.join(not_ready)}"))
+
+
+def replica_addrs(gv: Dict, inventory: Optional[str]) -> List[str]:
+    env = os.environ.get("TPU_PROBE_REPLICAS", "")
+    if env:
+        return [a.strip() for a in env.split(",") if a.strip()]
+    port = gv.get("serving_port", 8000)
+    vm = parse_inventory_vm(inventory)
+    kubectl = "kubectl --kubeconfig /etc/kubernetes/admin.conf"
+    ns = gv.get("serving_namespace", "tpu-serve")
+    try:
+        p = node_shell(vm, gv,
+                       f"{kubectl} -n {ns} get endpoints tpu-serving-engine "
+                       "-o jsonpath='{.subsets[*].addresses[*].ip}'")
+        ips = p.stdout.split() if p.returncode == 0 else []
+    except (OSError, subprocess.TimeoutExpired):
+        ips = []
+    if not ips:
+        fallback = os.environ.get("REHEARSE_ENGINE_IP", "")
+        ips = [fallback] if fallback else []
+    return [f"{ip}:{port}" for ip in ips]
+
+
+def probe_l3(gv: Dict, inventory: Optional[str]) -> ProbeResult:
+    addrs = replica_addrs(gv, inventory)
+    if not addrs:
+        return ProbeResult("L3", False, "no serving replicas discovered")
+    bad = []
+    for addr in addrs:
+        status, body = _http_get(f"http://{addr}/readyz")
+        if status != 200:
+            bad.append(f"{addr} /readyz={status} {body[:80]}")
+    return ProbeResult("L3", not bad,
+                       f"{len(addrs)} replica(s) "
+                       + ("all ready" if not bad else "; ".join(bad)))
+
+
+def gateway_addr(gv: Dict, inventory: Optional[str]) -> str:
+    env = os.environ.get("REHEARSE_GW_ADDR", "")
+    if env:
+        return env
+    vm = parse_inventory_vm(inventory)
+    kubectl = "kubectl --kubeconfig /etc/kubernetes/admin.conf"
+    ns = gv.get("serving_namespace", "tpu-serve")
+    gw = gv.get("gateway_name", "tpu-inference-gateway")
+    try:
+        p = node_shell(vm, gv,
+                       f"{kubectl} -n {ns} get svc {gw} -o "
+                       "jsonpath='{.spec.clusterIP}:{.spec.ports[0].port}'")
+        if p.returncode == 0 and p.stdout.strip():
+            return p.stdout.strip().splitlines()[-1]
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    return f"{gw}.{ns}.svc.cluster.local:80"
+
+
+def probe_l4(gv: Dict, inventory: Optional[str]) -> ProbeResult:
+    gw = gateway_addr(gv, inventory)
+    model = str(gv.get("model", ""))
+    status, body = _http_get(f"http://{gw}/v1/models")
+    if status != 200:
+        return ProbeResult("L4", False, f"gateway {gw} /v1/models={status}")
+    ok = model in body
+    return ProbeResult("L4", ok,
+                       f"gateway {gw} " + ("serves " + model if ok else
+                                           f"response lacks model {model}"))
+
+
+def probe_l5(gv: Dict, inventory: Optional[str]) -> ProbeResult:
+    override = os.environ.get("TPU_PROBE_COLLECTOR", "")
+    if override:
+        status, body = _http_get(override)
+        return ProbeResult("L5", status == 200,
+                           f"collector {override} -> {status}")
+    vm = parse_inventory_vm(inventory)
+    kubectl = "kubectl --kubeconfig /etc/kubernetes/admin.conf"
+    ns = gv.get("otel_namespace", "otel-monitoring")
+    try:
+        p = node_shell(vm, gv, f"{kubectl} -n {ns} get deploy --no-headers")
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return ProbeResult("L5", False, f"kubectl unreachable: {e}")
+    return ProbeResult("L5", p.returncode == 0,
+                       f"otel namespace {ns} rc={p.returncode}")
+
+
+PROBES: Dict[str, Callable[[Dict, Optional[str]], ProbeResult]] = {
+    "L1": probe_l1, "L2": probe_l2, "L3": probe_l3,
+    "L4": probe_l4, "L5": probe_l5,
+}
+
+
+def probe_all(gv: Dict, inventory: Optional[str],
+              layers=LAYERS) -> List[ProbeResult]:
+    return [PROBES[layer](gv, inventory) for layer in layers]
+
+
+def first_broken(results: List[ProbeResult]) -> Optional[str]:
+    for r in results:
+        if not r.ok:
+            return r.layer
+    return None
+
+
+# -- repairs -----------------------------------------------------------------
+
+
+def repair_l3_undrain(gv: Dict, inventory: Optional[str],
+                      log: Callable[[str], None] = print) -> bool:
+    """The cheap L3 repair: a replica that is alive but stuck draining (a
+    forgotten/failed rotation) is put back with /admin/undrain — no
+    playbook re-run, no pod churn. Returns True if every replica is ready
+    afterwards."""
+    fixed_any = False
+    for addr in replica_addrs(gv, inventory):
+        status, body = _http_get(f"http://{addr}/readyz")
+        if status == 503 and "draining" in body:
+            log(f"reconcile: {addr} is alive but draining — undraining")
+            _http_post(f"http://{addr}/admin/undrain", {})
+            fixed_any = True
+    if not fixed_any:
+        return False
+    return probe_l3(gv, inventory).ok
+
+
+# -- rolling restart under load (rehearse-kind / in-process tests) -----------
+
+
+def rolling_restart(replicas: List[str],
+                    restart_fn: Callable[[str], None],
+                    drain_timeout_s: float = 30.0,
+                    ready_timeout_s: float = 60.0,
+                    poll_s: float = 0.1,
+                    log: Callable[[str], None] = print) -> None:
+    """Restart every serving replica with zero dropped requests: drain
+    (rotation-only — the router's /load poller stops routing within one
+    poll), wait for in-flight work to quiesce, restart via the caller's
+    ``restart_fn``, wait for /readyz, undrain (no-op on a fresh process).
+    Raises RuntimeError if a replica never comes back."""
+    for addr in replicas:
+        log(f"rolling-restart: draining {addr}")
+        _http_post(f"http://{addr}/admin/drain", {"exit": False})
+        deadline = time.monotonic() + drain_timeout_s
+        while time.monotonic() < deadline:
+            status, body = _http_get(f"http://{addr}/healthz")
+            if status is None:
+                break                      # already down
+            try:
+                h = json.loads(body)
+            except ValueError:
+                h = {}
+            # inflight covers the admission/stream-out window where a /v1
+            # request lives only in a handler thread — the engine counters
+            # alone would let us kill a replica mid-request
+            if not h.get("active_requests") and not h.get("queue_depth") \
+                    and not h.get("inflight"):
+                break
+            time.sleep(poll_s)
+        log(f"rolling-restart: restarting {addr}")
+        restart_fn(addr)
+        deadline = time.monotonic() + ready_timeout_s
+        while time.monotonic() < deadline:
+            status, _ = _http_get(f"http://{addr}/readyz", timeout=2.0)
+            if status == 200:
+                break
+            time.sleep(poll_s)
+        else:
+            raise RuntimeError(f"replica {addr} not ready "
+                               f"{ready_timeout_s}s after restart")
+        _http_post(f"http://{addr}/admin/undrain", {})
+        log(f"rolling-restart: {addr} back in rotation")
+
+
+# -- seeded load loop (the zero-failed-requests assertion) -------------------
+
+
+def _collect_stream_ids(gw: str, payload: dict,
+                        timeout: float = 120.0):
+    """(status, token_ids, saw_done) for a streamed completion."""
+    req = urllib.request.Request(
+        f"http://{gw}/v1/completions",
+        data=json.dumps({**payload, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    ids: List[int] = []
+    done = False
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            status = r.status
+            for raw in r:
+                line = raw.decode(errors="replace").strip()
+                if line == "data: [DONE]":
+                    done = True
+                elif line.startswith("data: "):
+                    try:
+                        obj = json.loads(line[len("data: "):])
+                    except ValueError:
+                        continue
+                    for c in obj.get("choices", []):
+                        ids.extend(c.get("token_ids") or [])
+    except urllib.error.HTTPError as e:
+        return e.code, ids, done
+    except (OSError, ValueError) as e:
+        return None, ids, done
+    return status, ids, done
+
+
+def run_load(gw: str, model: str, stop: threading.Event,
+             concurrency: int = 3, max_tokens: int = 16) -> dict:
+    """Drive seeded streamed + unary completions at the gateway until
+    ``stop`` is set. Every streamed request uses a FIXED seed per worker,
+    so its token ids must be identical run after run — a restarted-mid-
+    stream replica that fails over produces the same bytes (the PR 3
+    failover assertion, reused as a load invariant). Returns counters:
+    requests / non_2xx / stream_mismatches / incomplete_streams."""
+    counters = {"requests": 0, "non_2xx": 0, "stream_mismatches": 0,
+                "incomplete_streams": 0}
+    lock = threading.Lock()
+
+    def worker(wid: int):
+        payload = {"model": model, "prompt": f"rolling restart probe {wid}",
+                   "max_tokens": max_tokens, "seed": 4200 + wid,
+                   "temperature": 0.7, "ignore_eos": True}
+        # the reference stream: same seed => every later stream must be
+        # token-identical, restarts or not
+        status, ref_ids, done = _collect_stream_ids(gw, payload)
+        with lock:
+            counters["requests"] += 1
+            if status != 200:
+                counters["non_2xx"] += 1
+            elif not done or len(ref_ids) != max_tokens:
+                counters["incomplete_streams"] += 1
+        if len(ref_ids) != max_tokens:
+            ref_ids = None              # unhealthy start: already counted
+        n = 0
+        while not stop.is_set():
+            n += 1
+            if n % 2 == 0:              # interleave unary requests
+                status, _ = _http_post(
+                    f"http://{gw}/v1/completions",
+                    {"model": model, "prompt": f"unary probe {wid}.{n}",
+                     "max_tokens": 4}, timeout=120.0)
+                with lock:
+                    counters["requests"] += 1
+                    if status != 200:
+                        counters["non_2xx"] += 1
+            status, ids, done = _collect_stream_ids(gw, payload)
+            with lock:
+                counters["requests"] += 1
+                if status != 200:
+                    counters["non_2xx"] += 1
+                elif not done:
+                    counters["incomplete_streams"] += 1
+                elif ref_ids is not None and ids != ref_ids:
+                    counters["stream_mismatches"] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        while t.is_alive():
+            t.join(timeout=1.0)
+    return counters
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description="layer health probes / "
+                                             "reconcile drivers")
+    ap.add_argument("--inventory")
+    ap.add_argument("--deploy-dir", default=DEPLOY_DIR)
+    ap.add_argument("--layer", choices=LAYERS,
+                    help="probe one layer only")
+    ap.add_argument("--first-broken", action="store_true",
+                    help="print the first unhealthy layer (or 'none')")
+    ap.add_argument("--repair-undrain", action="store_true",
+                    help="attempt the cheap L3 undrain repair; exit 0 if "
+                         "it made L3 healthy")
+    ap.add_argument("--load", metavar="GW",
+                    help="run the seeded load loop against host:port until "
+                         "--stop-file appears; write counters to --out")
+    ap.add_argument("--model")
+    ap.add_argument("--stop-file")
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--concurrency", type=int, default=3)
+    ap.add_argument("--out")
+    args = ap.parse_args(argv)
+
+    gv = load_group_vars(args.deploy_dir)
+
+    if args.load:
+        gw = args.load.replace("http://", "").rstrip("/")
+        stop = threading.Event()
+
+        def watcher():
+            deadline = time.monotonic() + args.duration
+            while time.monotonic() < deadline:
+                if args.stop_file and os.path.exists(args.stop_file):
+                    break
+                time.sleep(0.2)
+            stop.set()
+
+        threading.Thread(target=watcher, daemon=True).start()
+        counters = run_load(gw, args.model or str(gv.get("model", "")),
+                            stop, concurrency=args.concurrency)
+        text = json.dumps(counters, indent=1)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text + "\n")
+        print(text)
+        failed = counters["non_2xx"] + counters["stream_mismatches"] \
+            + counters["incomplete_streams"]
+        return 0 if counters["requests"] > 0 and failed == 0 else 1
+
+    if args.repair_undrain:
+        ok = repair_l3_undrain(gv, args.inventory)
+        print("repair-undrain: " + ("L3 healthy" if ok else "not repaired"))
+        return 0 if ok else 1
+
+    layers = (args.layer,) if args.layer else LAYERS
+    results = probe_all(gv, args.inventory, layers)
+    if args.first_broken:
+        print(first_broken(results) or "none")
+        return 0
+    report = {r.layer: r.as_dict() for r in results}
+    print(json.dumps(report, indent=1))
+    return 0 if all(r.ok for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+#!/usr/bin/env bash
+# Reconcile smoke: prove `deploy-tpu-cluster.sh reconcile` probes layer
+# health and repairs ONLY the broken layer (ISSUE r9 tentpole part 3):
+#
+#   stage 1  healthy stack -> reconcile reports nothing to do, exits 0
+#   stage 2  a serving replica stuck DRAINING (alive, /readyz 503)
+#            -> probes flag L3 first-broken; the reconciler's cheap
+#               in-place repair (undrain) restores /readyz 200 without
+#               re-running any playbook
+#   stage 3  L5 collector probe broken (override aimed at a dead port)
+#            -> the reconciler re-runs ONLY the L5 playbook, re-probes,
+#               and exits NON-ZERO because the probe still fails — it
+#               never claims a repair it cannot verify
+#   stage 4  override cleared -> reconcile healthy again
+#
+# Same hermetic substrate as resume-smoke (mount namespace, shims, sandbox
+# orchestrator copy, real tiny engine + router). Driven by
+# tests/test_reconcile.py (tier-1, marker reconcile_smoke) and
+# `make reconcile-smoke`. Prints "SMOKE_VERDICT: {json}" last.
+set -euo pipefail
+SMOKE_SELF="${BASH_SOURCE[0]}"
+SMOKE_ENGINE_PORT="${SMOKE_ENGINE_PORT:-18670}"
+SMOKE_ROUTER_PORT="${SMOKE_ROUTER_PORT:-18671}"
+source "$(dirname "${BASH_SOURCE[0]}")/smoke-lib.sh"
+smoke_reexec "$@"
+
+smoke_setup
+smoke_start_stack
+cd "$SBX"
+
+say "=== baseline: full deploy (healthy) ==="
+./deploy-tpu-cluster.sh deploy > "$WORK/deploy.log" 2>&1
+
+say "=== stage 1: healthy stack -> nothing to reconcile ==="
+out="$(./deploy-tpu-cluster.sh reconcile 2>&1)"
+case "$out" in
+    *"nothing to reconcile"*) say "assert ok: reconcile is a no-op when healthy" ;;
+    *) say "ASSERT FAILED: expected no-op reconcile, got: $out"; exit 1 ;;
+esac
+
+say "=== stage 2: stuck-draining replica -> L3 repaired in place (undrain) ==="
+curl -sf -X POST -H 'Content-Type: application/json' -d '{"exit": false}' \
+    "http://127.0.0.1:${ENGINE_PORT}/admin/drain" >/dev/null
+readyz_rc=0
+curl -sf "http://127.0.0.1:${ENGINE_PORT}/readyz" >/dev/null || readyz_rc=$?
+if [[ $readyz_rc -eq 0 ]]; then
+    say "ASSERT FAILED: replica still ready after drain"; exit 1
+fi
+out="$(./deploy-tpu-cluster.sh reconcile 2>&1)" || {
+    say "ASSERT FAILED: reconcile exited non-zero: $out"; exit 1; }
+case "$out" in
+    *"repaired in place"*) say "assert ok: reconcile undrained the replica" ;;
+    *) say "ASSERT FAILED: expected in-place L3 repair, got: $out"; exit 1 ;;
+esac
+curl -sf "http://127.0.0.1:${ENGINE_PORT}/readyz" >/dev/null || {
+    say "ASSERT FAILED: replica not ready after reconcile"; exit 1; }
+
+say "=== stage 3: broken L5 probe -> only L5 re-runs; honest failure when still broken ==="
+L4_RUNS_BEFORE="$(layer_field L4 runs)"
+rc=0
+out="$(TPU_PROBE_COLLECTOR="http://127.0.0.1:1/healthz" \
+    ./deploy-tpu-cluster.sh reconcile 2>&1)" || rc=$?
+if [[ $rc -eq 0 ]]; then
+    say "ASSERT FAILED: reconcile claimed success with a dead collector"; exit 1
+fi
+case "$out" in
+    *"re-running L5"*) say "assert ok: reconcile re-ran the L5 playbook" ;;
+    *) say "ASSERT FAILED: reconcile did not re-run L5: $out"; exit 1 ;;
+esac
+case "$out" in
+    *"STILL unhealthy"*) say "assert ok: reconcile reported the unrepaired probe" ;;
+    *) say "ASSERT FAILED: missing honest-failure report: $out"; exit 1 ;;
+esac
+assert_eq "stage3 L5 re-ran" "$(layer_field L5 runs)" "2"
+assert_eq "stage3 L4 untouched" "$(layer_field L4 runs)" "$L4_RUNS_BEFORE"
+
+say "=== stage 4: override cleared -> healthy again ==="
+out="$(./deploy-tpu-cluster.sh reconcile 2>&1)"
+case "$out" in
+    *"nothing to reconcile"*) say "assert ok: healthy after clearing the fault" ;;
+    *) say "ASSERT FAILED: expected healthy reconcile, got: $out"; exit 1 ;;
+esac
+
+echo "SMOKE_VERDICT: {\"ok\": true, \"smoke\": \"reconcile\", \"stages\": 4}"

@@ -113,5 +113,39 @@ METRICS_OUT="$(run_curl rehearse-metrics \
 echo "$METRICS_OUT" | grep -q '^tpu_serve_generated_tokens_total' \
   || { echo "FAIL: engine metrics missing"; exit 1; }
 
+# -- reconciler rolling restart under live load (ISSUE r9 / ROADMAP
+# "multi-replica drain chaos at scale") -------------------------------------
+# A seeded client load loop (deploy/probes.py --load: streamed + unary
+# completions; every seeded stream must stay token-identical run over run)
+# hammers the gateway through a port-forward while `kubectl rollout restart`
+# cycles every serving replica. The preStop drain + /readyz gates from PR 3
+# make the rollout graceful; the load report must show ZERO non-2xx, zero
+# truncated streams, zero stream mismatches.
+echo "==> reconcile: rolling restart under live load"
+# through the GATEWAY (the router re-routes around draining/restarting
+# replicas; a direct engine port-forward would pin to a dying pod)
+$KCTL -n "$NS" port-forward "svc/tpu-inference-gateway" 18710:80 \
+  >/dev/null 2>&1 &
+PF_PID=$!
+sleep 2
+STOPFILE="$(mktemp -u /tmp/rehearse-load.XXXXXX.stop)"
+LOAD_OUT="/tmp/rehearse-load-report.json"
+python3 deploy/probes.py --load "127.0.0.1:18710" --model "$MODEL" \
+  --stop-file "$STOPFILE" --duration 600 --concurrency 2 \
+  --out "$LOAD_OUT" &
+LOAD_PID=$!
+$KCTL -n "$NS" rollout restart deployment/tpu-serving-engine
+$KCTL -n "$NS" rollout status deployment/tpu-serving-engine --timeout=600s
+sleep 3                              # post-restart laps under the new pods
+touch "$STOPFILE"
+LOAD_RC=0
+wait "$LOAD_PID" || LOAD_RC=$?
+kill "$PF_PID" 2>/dev/null || true
+rm -f "$STOPFILE"
+cat "$LOAD_OUT"
+[ "$LOAD_RC" = 0 ] \
+  || { echo "FAIL: requests failed during the rolling restart"; exit 1; }
+
 echo "REHEARSAL PASSED: manifests applied, gateway routed, model listed," \
-     "completion generated, metrics scraped"
+     "completion generated, metrics scraped, rolling restart under load" \
+     "dropped zero requests"

@@ -37,15 +37,24 @@ fi
 WORK="$(mktemp -d /tmp/rehearse.XXXXXX)"
 export REHEARSE_STATE="$WORK/state"
 mkdir -p "$REHEARSE_STATE" "$WORK/etc" "$WORK/opt-tpu" "$WORK/opt-lpp" \
-    "$WORK/home" "$WORK/root-kube" "$WORK/usrlocal" "$WORK/hfcache"
+    "$WORK/home" "$WORK/root-kube" "$WORK/hfcache" \
+    "$WORK/ul-upper" "$WORK/ul-work"
 cp -a /etc/. "$WORK/etc/" 2>/dev/null || true
-cp -a /usr/local/. "$WORK/usrlocal/" 2>/dev/null || true
 mount --bind "$WORK/etc" /etc
 mount --bind "$WORK/opt-tpu" /opt/tpu-cluster
 mount --bind "$WORK/opt-lpp" /opt/local-path-provisioner
 mount --bind "$WORK/home" /home
 mount --bind "$WORK/root-kube" /root/.kube
-mount --bind "$WORK/usrlocal" /usr/local
+# /usr/local is GBs (python toolchain): an overlay upper dir isolates the
+# playbooks' writes without the minutes-long copy (fallback: copy only
+# /usr/local/bin, the one dir deploy/*.yaml touches)
+if ! mount -t overlay overlay \
+        -o "lowerdir=/usr/local,upperdir=$WORK/ul-upper,workdir=$WORK/ul-work" \
+        /usr/local 2>/dev/null; then
+    mkdir -p "$WORK/ul-bin"
+    cp -a /usr/local/bin/. "$WORK/ul-bin/" 2>/dev/null || true
+    mount --bind "$WORK/ul-bin" /usr/local/bin
+fi
 mount --bind "$WORK/hfcache" /root/.cache/huggingface
 echo "hf_rehearsal_token" > /root/.cache/huggingface/token
 mkdir -p /usr/local/bin /etc/apt/keyrings
@@ -141,7 +150,8 @@ run_play() {
 cd "$REPO"
 FAILED=""
 run_play L1 deploy/launch-tpu-vm.yaml || FAILED="L1"
-INV="$(ls -rt "$REPO"/tpu-inventory-*.ini 2>/dev/null | tail -1)"
+# deterministic newest-wins discovery (deploy/state.py, (mtime_ns, name))
+INV="$("$PYTHON" "$REPO/deploy/state.py" newest 'tpu-inventory-*.ini' --root "$REPO")"
 if [[ -z "$INV" ]]; then say "FATAL: L1 produced no inventory"; exit 4; fi
 say "using inventory: $INV (L1->L2 handoff contract)"
 [[ -z "$FAILED" ]] && { run_play L2 -i "$INV" deploy/kubernetes-single-node.yaml || FAILED="L2"; }
@@ -151,6 +161,12 @@ say "using inventory: $INV (L1->L2 handoff contract)"
 [[ -z "$FAILED" ]] && { run_play CLEANUP deploy/cleanup-tpu-vm.yaml || FAILED="CLEANUP"; }
 
 kill $ENGINE_PID $ROUTER_PID ${FWD_PID:-} 2>/dev/null || true
+
+# the CLEANUP phase journals per-VM outcomes into a tpu-deploy-state-*.json
+# next to the inventories (deploy/state.py record-cleanup); for a rehearsal
+# that journal is throwaway — drop any created after this run started
+find "$REPO" -maxdepth 1 -name 'tpu-deploy-state-*' -newer "$WORK" -delete \
+    2>/dev/null || true
 
 say ""
 say "=== rehearsal summary ==="

@@ -1,0 +1,99 @@
+#!/usr/bin/env bash
+# Resume smoke: prove the deploy state machine checkpoints, classifies, and
+# resumes (ISSUE r9 acceptance):
+#
+#   stage 1  deploy with a FATAL chaos failure injected mid-L3
+#            -> run stops, journal: L1 ok / L2 ok / L3 failed (fatal,
+#               classified reason carries the chaos message)
+#   stage 2  deploy --resume with the fault cleared
+#            -> completes; L1/L2 NOT re-run (runs stays 1, same inventory),
+#               L3 re-ran (runs=2), L4/L5 ran once, all ok
+#   stage 3  fresh deploy with a TRANSIENT chaos failure in an L2 task
+#            -> the executor retries with capped jittered exponential
+#               backoff and the deploy SUCCEEDS end-to-end; the task journal
+#               records attempts=3, the backoff schedule, and the transient
+#               classification it survived
+#   stage 4  cleanup -> every VM journaled, local state removed
+#
+# Runs hermetically (mount namespace + shims + sandbox copy of the
+# orchestrator); a real tiny engine + router serve the L4 gate. Driven by
+# tests/test_resume_smoke.py (tier-1, marker resume_smoke) and
+# `make resume-smoke`. Prints "SMOKE_VERDICT: {json}" last.
+set -euo pipefail
+SMOKE_SELF="${BASH_SOURCE[0]}"
+source "$(dirname "${BASH_SOURCE[0]}")/smoke-lib.sh"
+smoke_reexec "$@"
+
+smoke_setup
+smoke_start_stack
+cd "$SBX"
+
+say "=== stage 1: fatal chaos mid-L3 stops the deploy with a classified journal ==="
+rc=0
+MINI_ANSIBLE_CHAOS="Render serving manifests:fatal:99" \
+    ./deploy-tpu-cluster.sh deploy > "$WORK/stage1.log" 2>&1 || rc=$?
+if [[ $rc -eq 0 ]]; then
+    say "ASSERT FAILED: deploy succeeded despite fatal chaos"; exit 1
+fi
+assert_eq "stage1 L1 status" "$(layer_field L1 status)" "ok"
+assert_eq "stage1 L2 status" "$(layer_field L2 status)" "ok"
+assert_eq "stage1 L3 status" "$(layer_field L3 status)" "failed"
+assert_eq "stage1 L3 class"  "$(layer_field L3 failure_class)" "fatal"
+case "$(layer_field L3 reason)" in
+    *chaos*) say "assert ok: stage1 L3 reason carries the chaos message" ;;
+    *) say "ASSERT FAILED: L3 reason lacks chaos marker: $(layer_field L3 reason)"
+       exit 1 ;;
+esac
+INV1="$("$PYTHON" deploy/state.py newest 'tpu-inventory-*.ini' --root "$SBX")"
+
+say "=== stage 2: deploy --resume completes from exactly L3 ==="
+./deploy-tpu-cluster.sh deploy --resume > "$WORK/stage2.log" 2>&1
+for layer in L1 L2 L3 L4 L5; do
+    assert_eq "stage2 $layer status" "$(layer_field $layer status)" "ok"
+done
+assert_eq "stage2 L1 runs (not re-run)" "$(layer_field L1 runs)" "1"
+assert_eq "stage2 L2 runs (not re-run)" "$(layer_field L2 runs)" "1"
+assert_eq "stage2 L3 runs (re-ran)"     "$(layer_field L3 runs)" "2"
+assert_eq "stage2 L4 runs"              "$(layer_field L4 runs)" "1"
+assert_eq "stage2 L5 runs"              "$(layer_field L5 runs)" "1"
+INV2="$("$PYTHON" deploy/state.py newest 'tpu-inventory-*.ini' --root "$SBX")"
+assert_eq "stage2 same inventory (L1 skipped)" "$INV2" "$INV1"
+grep -q "checkpointed ok (fingerprint unchanged)" "$WORK/stage2.log" || {
+    say "ASSERT FAILED: resume did not report checkpoint skips"; exit 1; }
+
+say "=== stage 3: transient L2 chaos — deploy retries with backoff and succeeds ==="
+rm -f "$SBX"/tpu-deploy-state-*
+MINI_ANSIBLE_CHAOS="Verify CRI-O is active:transient:2" \
+    ./deploy-tpu-cluster.sh deploy > "$WORK/stage3.log" 2>&1
+for layer in L1 L2 L3 L4 L5; do
+    assert_eq "stage3 $layer status" "$(layer_field $layer status)" "ok"
+done
+TASKJ="$(newest_state_file)"; TASKJ="${TASKJ%.json}.tasks.jsonl"
+"$PYTHON" - "$TASKJ" <<'EOF'
+import json, sys
+recs = [json.loads(l) for l in open(sys.argv[1])]
+[rec] = [r for r in recs if r.get("chaos") == "transient"]
+assert rec["attempts"] == 3, rec
+assert rec["failed"] is False, rec
+assert rec["failure_class"] == "transient", rec
+assert len(rec["backoff_s"]) == 2, rec
+# capped jittered exponential: second sleep larger than the first
+assert rec["backoff_s"][1] > rec["backoff_s"][0], rec
+print("[smoke] assert ok: transient retry record", rec["backoff_s"])
+EOF
+
+say "=== stage 4: cleanup journals per-VM outcomes and clears local state ==="
+./deploy-tpu-cluster.sh cleanup > "$WORK/stage4.log" 2>&1
+if ls "$SBX"/tpu-inventory-*.ini >/dev/null 2>&1; then
+    say "ASSERT FAILED: cleanup left inventory files"; exit 1
+fi
+"$PYTHON" - "$(newest_state_file)" <<'EOF'
+import json, sys
+state = json.load(open(sys.argv[1]))
+assert state["cleanup"], "no per-VM cleanup records journaled"
+assert all(c["outcome"] in ("deleted", "already_absent")
+           for c in state["cleanup"]), state["cleanup"]
+print("[smoke] assert ok: cleanup journal", state["cleanup"])
+EOF
+
+echo "SMOKE_VERDICT: {\"ok\": true, \"smoke\": \"resume\", \"stages\": 4}"

@@ -1,0 +1,149 @@
+#!/usr/bin/env bash
+# Shared plumbing for the self-healing smoke scripts
+# (deploy/resume-smoke.sh, deploy/reconcile-smoke.sh).
+#
+# Gives each smoke the same hermetic substrate rehearse-local.sh uses —
+# a mount namespace with throwaway /etc (+ the other absolute paths the
+# playbooks write), cloud/cluster shims on PATH, compressed retry delays —
+# plus a SANDBOX COPY of the orchestrator and deploy tree, so the
+# journal/inventory/state files the state machine writes land in a
+# throwaway dir instead of the repo root, and a REAL tiny engine + router
+# the L4 gate and the reconciler probes hit.
+#
+# Scripts source this, then call:  smoke_reexec "$@"; smoke_setup;
+# smoke_start_stack; and use $SBX (sandboxed orchestrator dir), say(),
+# state_field() and layer_field() helpers.
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+PYTHON="${PYTHON:-python3}"
+ENGINE_PORT="${SMOKE_ENGINE_PORT:-18660}"
+ROUTER_PORT="${SMOKE_ROUTER_PORT:-18661}"
+
+smoke_reexec() {
+    # Re-exec the CALLING script inside a fresh mount namespace; the few
+    # absolute mountpoints the playbooks touch are created (and removed)
+    # around it, exactly like rehearse-local.sh. The outer wrapper owns the
+    # work dir: removed on success, kept (and named) on failure for
+    # debugging.
+    if [[ "${SMOKE_INNER:-}" != "1" ]]; then
+        local created=() d rc=0
+        for d in /opt/tpu-cluster /opt/local-path-provisioner /root/.kube \
+                 /root/.cache/huggingface; do
+            if [[ ! -e "$d" ]]; then mkdir -p "$d"; created+=("$d"); fi
+        done
+        SMOKE_WORK="$(mktemp -d /tmp/smoke.XXXXXX)"
+        SMOKE_INNER=1 SMOKE_WORK="$SMOKE_WORK" \
+            unshare --mount bash "${SMOKE_SELF}" "$@" || rc=$?
+        for d in "${created[@]:-}"; do
+            [[ -n "$d" ]] && rmdir "$d" 2>/dev/null || true
+        done
+        if [[ "$rc" == 0 ]]; then
+            rm -rf "$SMOKE_WORK"
+        else
+            echo "[smoke] FAILED (rc=$rc) — logs kept in $SMOKE_WORK" >&2
+        fi
+        exit "$rc"
+    fi
+}
+
+smoke_setup() {
+    WORK="${SMOKE_WORK:-$(mktemp -d /tmp/smoke.XXXXXX)}"
+    export REHEARSE_STATE="$WORK/state"
+    mkdir -p "$REHEARSE_STATE" "$WORK/etc" "$WORK/opt-tpu" "$WORK/opt-lpp" \
+        "$WORK/home" "$WORK/root-kube" "$WORK/hfcache" \
+        "$WORK/ul-upper" "$WORK/ul-work"
+    cp -a /etc/. "$WORK/etc/" 2>/dev/null || true
+    mount --bind "$WORK/etc" /etc
+    mount --bind "$WORK/opt-tpu" /opt/tpu-cluster
+    mount --bind "$WORK/opt-lpp" /opt/local-path-provisioner
+    mount --bind "$WORK/home" /home
+    mount --bind "$WORK/root-kube" /root/.kube
+    # /usr/local is GBs (python toolchain): copying it like rehearse-local
+    # does costs minutes, so writes go to an overlay upper dir instead
+    # (fallback: copy just /usr/local/bin, the only dir the playbooks touch)
+    if ! mount -t overlay overlay \
+            -o "lowerdir=/usr/local,upperdir=$WORK/ul-upper,workdir=$WORK/ul-work" \
+            /usr/local 2>/dev/null; then
+        mkdir -p "$WORK/ul-bin"
+        cp -a /usr/local/bin/. "$WORK/ul-bin/" 2>/dev/null || true
+        mount --bind "$WORK/ul-bin" /usr/local/bin
+    fi
+    mount --bind "$WORK/hfcache" /root/.cache/huggingface
+    echo "hf_rehearsal_token" > /root/.cache/huggingface/token
+    mkdir -p /usr/local/bin /etc/apt/keyrings
+    touch /usr/local/bin/helm    # 'creates:' guard for the helm install task
+
+    # sandbox copy: state/inventory/journal files stay out of the repo;
+    # the repo sources build-image.yaml stages are symlinked, not copied
+    SBX="$WORK/sandbox"
+    mkdir -p "$SBX/deploy"
+    cp "$REPO/deploy-tpu-cluster.sh" "$SBX/"
+    cp "$REPO"/deploy/*.yaml "$REPO"/deploy/*.py "$SBX/deploy/"
+    cp -r "$REPO/deploy/tasks" "$REPO/deploy/manifests" "$SBX/deploy/"
+    cp -r "$REPO/templates" "$SBX/templates"
+    local src
+    for src in Dockerfile pyproject.toml aws_k8s_ansible_provisioner_tpu \
+               native; do
+        ln -s "$REPO/$src" "$SBX/$src"
+    done
+
+    export PATH="$REPO/deploy/shims:$PATH"
+    export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+    export MINI_ANSIBLE_DELAY_SCALE="${MINI_ANSIBLE_DELAY_SCALE:-0.02}"
+    export MINI_ANSIBLE_WAITFOR_SKIP=1
+    export MINI_ANSIBLE_REHEARSAL=1
+    export REHEARSE_GW_ADDR="127.0.0.1:${ROUTER_PORT}"
+    export REHEARSE_ENGINE_IP="127.0.0.1"
+    # tiny model + the engine's real port, single config source for every
+    # playbook AND the probes
+    export TPU_DEPLOY_VARS="model=tiny-qwen3 serving_port=${ENGINE_PORT}"
+}
+
+say() { echo "[smoke] $*"; }
+
+smoke_start_stack() {
+    say "starting tiny engine :${ENGINE_PORT} + router :${ROUTER_PORT}"
+    JAX_PLATFORMS="" JAX_COMPILATION_CACHE_DIR="$WORK/jaxcache" \
+    "$PYTHON" -m aws_k8s_ansible_provisioner_tpu.serving.server \
+        --model tiny-qwen3 --platform cpu --port "$ENGINE_PORT" \
+        --max-decode-slots 4 --max-cache-len 256 --dtype float32 \
+        --weights-dtype bf16 --no-warmup > "$WORK/engine.log" 2>&1 &
+    ENGINE_PID=$!
+    "$PYTHON" -m aws_k8s_ansible_provisioner_tpu.serving.router \
+        --backend-service "127.0.0.1:${ENGINE_PORT}" --port "$ROUTER_PORT" \
+        > "$WORK/router.log" 2>&1 &
+    ROUTER_PID=$!
+    trap 'kill $ENGINE_PID $ROUTER_PID 2>/dev/null || true' EXIT
+    local i
+    for i in $(seq 1 60); do
+        curl -sf "http://127.0.0.1:${ROUTER_PORT}/v1/models" >/dev/null && break
+        sleep 1
+    done
+    curl -sf "http://127.0.0.1:${ROUTER_PORT}/v1/models" >/dev/null || {
+        say "FATAL: engine/router did not come up"
+        tail -30 "$WORK/engine.log" "$WORK/router.log" || true
+        exit 3
+    }
+    say "stack live at ${REHEARSE_GW_ADDR}"
+}
+
+newest_state_file() {
+    "$PYTHON" "$SBX/deploy/state.py" newest 'tpu-deploy-state-*.json' \
+        --root "$SBX"
+}
+
+layer_field() {
+    # layer_field L3 status  -> prints the field from the newest journal
+    "$PYTHON" - "$(newest_state_file)" "$1" "$2" <<'EOF'
+import json, sys
+print(json.load(open(sys.argv[1]))["layers"][sys.argv[2]][sys.argv[3]])
+EOF
+}
+
+assert_eq() {  # assert_eq <label> <got> <want>
+    if [[ "$2" != "$3" ]]; then
+        say "ASSERT FAILED: $1: got '$2' want '$3'"
+        exit 1
+    fi
+    say "assert ok: $1 = $2"
+}

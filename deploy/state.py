@@ -1,0 +1,296 @@
+#!/usr/bin/env python3
+"""Deploy journal: the checkpointed, resumable state machine behind
+``deploy-tpu-cluster.sh``.
+
+The reference orchestrator was ``set -e`` fail-fast with no memory: a
+transient gcloud quota error in L2 stranded a half-built (billing) TPU VM
+and forced a full cleanup+redeploy (reference deploy-k8s-cluster.sh:3).
+This module gives the L0 CLI a journal — one JSON file per deploy run,
+``tpu-deploy-state-<epoch>.json`` next to the inventory files — recording
+each layer L1..L5 as ``pending/running/ok/failed`` with a per-layer
+fingerprint (sha256 of the playbook bytes + the generated group_vars), so
+
+  ``deploy-tpu-cluster.sh deploy --resume``
+
+skips every ``ok`` layer whose fingerprint still matches and re-runs from
+the first failed/stale layer. Failed layers carry the failure class
+(transient/fatal) and classified reason extracted from the miniansible
+task journal, so the operator (and the reconciler) know whether a retry
+is even worth it.
+
+Also here, because every consumer of generated state files needs it: the
+deterministic newest-file helper that replaces the orchestrator's fragile
+``ls -rt | tail -1`` discovery (ties broke on directory order; this sorts
+on (mtime_ns, name) so equal-mtime files resolve the same way on every
+filesystem), shared by deploy / cleanup / reconcile, and the per-VM
+cleanup outcome journal (``cleanup`` records deleted/already_absent/error
+per VM instead of silently orphaning inventories).
+
+CLI (used by deploy-tpu-cluster.sh and cleanup-tpu-vm.yaml):
+    state.py newest 'tpu-inventory-*.ini' [--root DIR]
+    state.py init --state FILE
+    state.py fingerprint LAYER [--deploy-dir DIR]
+    state.py should-skip LAYER --state FILE --fingerprint HEX   (exit 0 = skip)
+    state.py begin LAYER --state FILE --fingerprint HEX
+    state.py finish LAYER --state FILE --status ok|failed
+              [--reason STR] [--from-journal tasks.jsonl]
+    state.py record-cleanup --vm NAME --outcome deleted|already_absent|error
+              [--detail STR] [--root DIR | --state FILE]
+    state.py show --state FILE [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globmod
+import hashlib
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+LAYERS = ("L1", "L2", "L3", "L4", "L5")
+PLAYBOOKS = {
+    "L1": "launch-tpu-vm.yaml",
+    "L2": "kubernetes-single-node.yaml",
+    "L3": "serving-deploy.yaml",
+    "L4": "serving-test.yaml",
+    "L5": "otel-observability-setup.yaml",
+}
+STATE_GLOB = "tpu-deploy-state-*.json"
+
+
+def utcnow() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def newest(pattern: str, root: Optional[str] = None) -> Optional[str]:
+    """Deterministic newest-wins file discovery: max by (mtime_ns, name).
+
+    ``ls -rt | tail -1`` leaves equal-mtime ordering to the filesystem;
+    tie-breaking on the name makes discovery reproducible everywhere."""
+    if root:
+        pattern = os.path.join(root, pattern)
+    paths = globmod.glob(pattern)
+    if not paths:
+        return None
+    return max(paths, key=lambda p: (os.stat(p).st_mtime_ns,
+                                     os.path.basename(p)))
+
+
+def layer_fingerprint(layer: str, deploy_dir: str) -> str:
+    """sha256 over the layer's playbook bytes + the generated group_vars:
+    a checkpointed layer is only skippable while BOTH are unchanged."""
+    h = hashlib.sha256()
+    pb = os.path.join(deploy_dir, PLAYBOOKS[layer])
+    with open(pb, "rb") as f:
+        h.update(f.read())
+    for name in ("all.yaml", "all.yml"):
+        gv = os.path.join(deploy_dir, "group_vars", name)
+        if os.path.exists(gv):
+            with open(gv, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+class DeployState:
+    """One deploy run's journal (JSON file, read-modify-write per update —
+    the orchestrator is single-threaded, durability beats locking here)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                self.data: Dict[str, Any] = json.load(f)
+        else:
+            self.data = {
+                "version": 1,
+                "created": utcnow(),
+                "layers": {
+                    layer: {"status": "pending", "playbook": PLAYBOOKS[layer],
+                            "fingerprint": None, "runs": 0,
+                            "started": None, "finished": None,
+                            "failure_class": None, "reason": None}
+                    for layer in LAYERS
+                },
+                "cleanup": [],
+            }
+
+    def save(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.data, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, self.path)
+
+    def layer(self, name: str) -> Dict[str, Any]:
+        return self.data["layers"][name]
+
+    def begin(self, name: str, fingerprint: str) -> None:
+        rec = self.layer(name)
+        rec.update(status="running", fingerprint=fingerprint,
+                   started=utcnow(), finished=None,
+                   failure_class=None, reason=None)
+        rec["runs"] = int(rec.get("runs", 0)) + 1
+        self.save()
+
+    def finish(self, name: str, status: str,
+               failure_class: Optional[str] = None,
+               reason: Optional[str] = None) -> None:
+        rec = self.layer(name)
+        rec.update(status=status, finished=utcnow(),
+                   failure_class=failure_class, reason=reason)
+        self.save()
+
+    def should_skip(self, name: str, fingerprint: str) -> bool:
+        """Resume contract: skip only layers that finished ``ok`` AND whose
+        inputs (playbook + group_vars) are fingerprint-identical."""
+        rec = self.layer(name)
+        return rec["status"] == "ok" and rec["fingerprint"] == fingerprint
+
+    def record_cleanup(self, vm: str, outcome: str, detail: str = "") -> None:
+        self.data["cleanup"].append({"vm": vm, "outcome": outcome,
+                                     "detail": detail, "time": utcnow()})
+        self.save()
+
+    def summary(self) -> str:
+        lines = [f"deploy state {os.path.basename(self.path)} "
+                 f"(created {self.data['created']})"]
+        for name in LAYERS:
+            rec = self.layer(name)
+            extra = ""
+            if rec["status"] == "failed":
+                extra = f"  [{rec.get('failure_class') or 'unclassified'}] " \
+                        f"{rec.get('reason') or ''}"
+            lines.append(f"  {name} {rec['playbook']:<32} {rec['status']:<8}"
+                         f" runs={rec.get('runs', 0)}{extra}")
+        for c in self.data.get("cleanup", []):
+            lines.append(f"  cleanup {c['vm']}: {c['outcome']} {c['detail']}")
+        return "\n".join(lines)
+
+
+def failure_from_journal(journal_path: str) -> Dict[str, Optional[str]]:
+    """Pull the classified failure out of a miniansible task journal: the
+    LAST failed record wins (the task that aborted the layer)."""
+    last: Dict[str, Any] = {}
+    try:
+        with open(journal_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("failed"):
+                    last = rec
+    except OSError:
+        pass
+    if not last:
+        return {"failure_class": None, "reason": None}
+    reason = last.get("failure_reason") or last.get("msg") or ""
+    return {"failure_class": last.get("failure_class"),
+            "reason": f"task {last.get('task')!r}: {reason}".strip()}
+
+
+def _resolve_state(args: argparse.Namespace) -> DeployState:
+    if getattr(args, "state", None):
+        return DeployState(args.state)
+    root = getattr(args, "root", None) or "."
+    path = newest(STATE_GLOB, root)
+    if path is None:
+        path = os.path.join(root, f"tpu-deploy-state-{int(time.time())}.json")
+    return DeployState(path)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("newest", help="deterministic newest file by glob")
+    p.add_argument("pattern")
+    p.add_argument("--root")
+
+    p = sub.add_parser("init")
+    p.add_argument("--state", required=True)
+
+    p = sub.add_parser("fingerprint")
+    p.add_argument("layer", choices=LAYERS)
+    p.add_argument("--deploy-dir",
+                   default=os.path.dirname(os.path.abspath(__file__)))
+
+    p = sub.add_parser("should-skip")
+    p.add_argument("layer", choices=LAYERS)
+    p.add_argument("--state", required=True)
+    p.add_argument("--fingerprint", required=True)
+
+    p = sub.add_parser("begin")
+    p.add_argument("layer", choices=LAYERS)
+    p.add_argument("--state", required=True)
+    p.add_argument("--fingerprint", required=True)
+
+    p = sub.add_parser("finish")
+    p.add_argument("layer", choices=LAYERS)
+    p.add_argument("--state", required=True)
+    p.add_argument("--status", required=True, choices=("ok", "failed"))
+    p.add_argument("--reason")
+    p.add_argument("--from-journal",
+                   help="miniansible task journal to classify the failure from")
+
+    p = sub.add_parser("record-cleanup")
+    p.add_argument("--vm", required=True)
+    p.add_argument("--outcome", required=True,
+                   choices=("deleted", "already_absent", "error"))
+    p.add_argument("--detail", default="")
+    p.add_argument("--state")
+    p.add_argument("--root")
+
+    p = sub.add_parser("show")
+    p.add_argument("--state", required=True)
+    p.add_argument("--json", action="store_true")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "newest":
+        path = newest(args.pattern, args.root)
+        if path:
+            print(path)
+        return 0
+    if args.cmd == "fingerprint":
+        print(layer_fingerprint(args.layer, args.deploy_dir))
+        return 0
+    if args.cmd == "init":
+        DeployState(args.state).save()
+        return 0
+    if args.cmd == "should-skip":
+        st = DeployState(args.state)
+        return 0 if st.should_skip(args.layer, args.fingerprint) else 1
+    if args.cmd == "begin":
+        DeployState(args.state).begin(args.layer, args.fingerprint)
+        return 0
+    if args.cmd == "finish":
+        st = DeployState(args.state)
+        cls, reason = None, args.reason
+        if args.status == "failed" and args.from_journal:
+            got = failure_from_journal(args.from_journal)
+            cls = got["failure_class"]
+            reason = got["reason"] or reason
+        st.finish(args.layer, args.status, failure_class=cls, reason=reason)
+        return 0
+    if args.cmd == "record-cleanup":
+        st = _resolve_state(args)
+        st.record_cleanup(args.vm, args.outcome, args.detail)
+        print(f"journaled cleanup of {args.vm}: {args.outcome} "
+              f"-> {st.path}")
+        return 0
+    if args.cmd == "show":
+        st = DeployState(args.state)
+        if args.json:
+            print(json.dumps(st.data, indent=1))
+        else:
+            print(st.summary())
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,8 +41,33 @@ def test_cli_dispatches_all_layers():
         if pb == "cleanup-tpu-vm.yaml":
             continue
         assert pb in text, f"CLI does not sequence {pb}"
-    for sub in ("deploy)", "cleanup)", "-h|--help)"):
+    for sub in ("deploy)", "cleanup)", "reconcile)", "-h|--help)"):
         assert sub in text, f"CLI missing subcommand {sub}"
+
+
+def test_cli_is_a_checkpointed_state_machine():
+    """r9: every layer goes through run_layer (journal + fingerprint +
+    resume skip), discovery is the deterministic Python helper, and the
+    failure path points the operator at --resume."""
+    text = (REPO / "deploy-tpu-cluster.sh").read_text()
+    assert "state.py" in text and "probes.py" in text
+    assert "--resume" in text and "should-skip" in text
+    assert "fingerprint" in text
+    assert "ls -rt" not in text          # deterministic discovery only
+    for layer in ("L1", "L2", "L3", "L4", "L5"):
+        assert f"run_layer {layer} " in text, f"{layer} bypasses the journal"
+
+
+def test_state_layer_table_matches_cli():
+    """deploy/state.py's layer->playbook table is the single source the
+    fingerprints and reconcile dispatch rely on; it must match the CLI."""
+    import sys
+    sys.path.insert(0, str(DEPLOY))
+    import state as deploy_state
+    cli = (REPO / "deploy-tpu-cluster.sh").read_text()
+    for layer, pb in deploy_state.PLAYBOOKS.items():
+        assert pb in PLAYBOOKS
+        assert pb in cli
 
 
 @pytest.mark.parametrize("name", PLAYBOOKS)
@@ -220,9 +245,13 @@ def test_manifests_never_pull_framework_image():
 
 
 def test_cleanup_removes_local_state():
+    # r9: local-state removal is per-VM and outcome-gated (a failed
+    # deletion keeps its inventory/details so the VM is never orphaned)
     text = (DEPLOY / "cleanup-tpu-vm.yaml").read_text()
-    for needle in ("tpu-inventory-*.ini", "tpu-instance-*-details.txt",
-                   "kubeconfig-*", "tpus tpu-vm delete"):
+    for needle in ("tpu-inventory-*.ini",
+                   "tpu-instance-{{ item.0[0] }}-details.txt",
+                   "kubeconfig-{{ item.0[0] }}", "tpus tpu-vm delete",
+                   "record-cleanup"):
         assert needle in text
 
 

@@ -1,0 +1,230 @@
+"""Deploy journal + deterministic state discovery (deploy/state.py) and the
+idempotent cleanup playbook (r9 tentpole/satellites).
+
+The resumable deploy state machine rides on three contracts tested here:
+(1) `newest` is deterministic — (mtime_ns, name) ordering, not `ls -rt`'s
+filesystem-order ties; (2) the layer journal's should-skip answers resume
+correctly across ok/failed/stale-fingerprint states; (3) cleanup tolerates
+already-deleted VMs, keeps the inventory of a FAILED deletion (no orphaned
+billing VM), and journals every outcome per VM."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "deploy"))
+
+import miniansible  # noqa: E402
+import state  # noqa: E402
+
+
+# -- newest (deterministic ls -rt replacement) ------------------------------
+
+
+def test_newest_by_mtime(tmp_path):
+    for i, name in enumerate(["tpu-inventory-a.ini", "tpu-inventory-b.ini",
+                              "tpu-inventory-c.ini"]):
+        p = tmp_path / name
+        p.write_text("x")
+        os.utime(p, ns=(1000 + i, (1000 + i) * 10**9))
+    got = state.newest("tpu-inventory-*.ini", str(tmp_path))
+    assert os.path.basename(got) == "tpu-inventory-c.ini"
+
+
+def test_newest_tie_breaks_on_name(tmp_path):
+    # equal mtimes: ls -rt leaves the order to the filesystem; newest()
+    # must resolve the tie identically everywhere (highest name wins)
+    for name in ["tpu-inventory-zz.ini", "tpu-inventory-aa.ini",
+                 "tpu-inventory-mm.ini"]:
+        p = tmp_path / name
+        p.write_text("x")
+        os.utime(p, ns=(5000 * 10**9, 5000 * 10**9))
+    got = state.newest("tpu-inventory-*.ini", str(tmp_path))
+    assert os.path.basename(got) == "tpu-inventory-zz.ini"
+
+
+def test_newest_empty(tmp_path):
+    assert state.newest("tpu-inventory-*.ini", str(tmp_path)) is None
+
+
+# -- layer journal / resume contract ----------------------------------------
+
+
+def test_state_machine_begin_finish_skip(tmp_path):
+    sf = str(tmp_path / "tpu-deploy-state-1.json")
+    st = state.DeployState(sf)
+    st.save()
+    assert st.layer("L2")["status"] == "pending"
+    assert not st.should_skip("L2", "fp1")
+
+    st.begin("L2", "fp1")
+    assert st.layer("L2")["status"] == "running"
+    assert st.layer("L2")["runs"] == 1
+    st.finish("L2", "ok")
+    # skip only while the fingerprint matches
+    assert st.should_skip("L2", "fp1")
+    assert not st.should_skip("L2", "fp2")
+
+    # reload from disk: the journal is the source of truth
+    st2 = state.DeployState(sf)
+    assert st2.should_skip("L2", "fp1")
+
+    st2.begin("L2", "fp2")
+    st2.finish("L2", "failed", failure_class="transient", reason="quota")
+    assert not st2.should_skip("L2", "fp2")
+    rec = st2.layer("L2")
+    assert rec["runs"] == 2
+    assert rec["failure_class"] == "transient"
+    assert "quota" in rec["reason"]
+
+
+def test_fingerprint_tracks_playbook_and_group_vars(tmp_path):
+    dd = tmp_path / "deploy"
+    (dd / "group_vars").mkdir(parents=True)
+    (dd / "kubernetes-single-node.yaml").write_text("- hosts: localhost\n")
+    (dd / "group_vars" / "all.yaml").write_text("a: 1\n")
+    fp1 = state.layer_fingerprint("L2", str(dd))
+    assert fp1 == state.layer_fingerprint("L2", str(dd))  # stable
+    (dd / "group_vars" / "all.yaml").write_text("a: 2\n")
+    fp2 = state.layer_fingerprint("L2", str(dd))
+    assert fp2 != fp1                                     # vars change
+    (dd / "kubernetes-single-node.yaml").write_text("- hosts: all\n")
+    assert state.layer_fingerprint("L2", str(dd)) != fp2  # playbook change
+
+
+def test_failure_from_journal_takes_last_failed(tmp_path):
+    j = tmp_path / "tasks.jsonl"
+    j.write_text("\n".join([
+        json.dumps({"task": "ok task", "failed": False}),
+        json.dumps({"task": "first fail", "failed": True,
+                    "failure_class": "transient",
+                    "failure_reason": "timed out"}),
+        json.dumps({"task": "aborting fail", "failed": True,
+                    "failure_class": "fatal",
+                    "failure_reason": "invalid argument"}),
+    ]) + "\n")
+    got = state.failure_from_journal(str(j))
+    assert got["failure_class"] == "fatal"
+    assert "aborting fail" in got["reason"]
+    assert "invalid argument" in got["reason"]
+
+
+def test_cli_round_trip(tmp_path):
+    sf = str(tmp_path / "tpu-deploy-state-7.json")
+    env = dict(os.environ)
+    run = lambda *a: subprocess.run(  # noqa: E731
+        [sys.executable, os.path.join(REPO, "deploy", "state.py"), *a],
+        capture_output=True, text=True, env=env)
+    assert run("init", "--state", sf).returncode == 0
+    assert run("should-skip", "L1", "--state", sf,
+               "--fingerprint", "x").returncode == 1
+    assert run("begin", "L1", "--state", sf,
+               "--fingerprint", "x").returncode == 0
+    assert run("finish", "L1", "--state", sf, "--status", "ok").returncode == 0
+    assert run("should-skip", "L1", "--state", sf,
+               "--fingerprint", "x").returncode == 0
+    p = run("show", "--state", sf, "--json")
+    data = json.loads(p.stdout)
+    assert data["layers"]["L1"]["status"] == "ok"
+    # record-cleanup appends to the newest state in --root
+    assert run("record-cleanup", "--root", str(tmp_path), "--vm", "vm-1",
+               "--outcome", "already_absent").returncode == 0
+    data = json.loads(run("show", "--state", sf, "--json").stdout)
+    assert data["cleanup"][0]["vm"] == "vm-1"
+    assert data["cleanup"][0]["outcome"] == "already_absent"
+
+
+# -- idempotent cleanup playbook --------------------------------------------
+
+
+GCLOUD_STUB = textwrap.dedent("""\
+    #!/usr/bin/env bash
+    joined="$*"
+    case "$joined" in
+      *describe*) echo "whatever READY v5litepod-8";;
+      *delete*vm-good*) echo "Deleted.";;
+      *delete*vm-gone*) echo "ERROR: NOT_FOUND" >&2; exit 1;;
+      *delete*vm-stuck*) echo "ERROR: internal error" >&2; exit 1;;
+    esac
+    """)
+
+
+@pytest.fixture()
+def cleanup_env(tmp_path):
+    """A root dir with three inventories (one VM deletable, one already
+    gone, one whose deletion fails) and a gcloud stub on PATH."""
+    dd = tmp_path / "deploy"
+    (dd / "group_vars").mkdir(parents=True)
+    for f in ("cleanup-tpu-vm.yaml", "state.py"):
+        (dd / f).write_bytes(
+            open(os.path.join(REPO, "deploy", f), "rb").read())
+    (dd / "group_vars" / "all.yaml").write_text(
+        'gcp_zone: "z1"\ngcp_project: "p1"\n')
+    for vm in ("vm-good", "vm-gone", "vm-stuck"):
+        (tmp_path / f"tpu-inventory-{vm}.ini").write_text(
+            f"[tpu_instances]\n1.1.1.1 tpu_name={vm}\n"
+            "[tpu_instances:vars]\ntpu_zone=z1\ntpu_project=p1\n")
+        (tmp_path / f"tpu-instance-{vm}-details.txt").write_text("d")
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    (bindir / "gcloud").write_text(GCLOUD_STUB)
+    os.chmod(bindir / "gcloud", 0o755)
+    env = dict(os.environ)
+    env["PATH"] = f"{bindir}:{env['PATH']}"
+    return tmp_path, dd, env
+
+
+def test_cleanup_keeps_inventory_of_failed_deletion(cleanup_env):
+    root, dd, env = cleanup_env
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "deploy", "miniansible.py"),
+         str(dd / "cleanup-tpu-vm.yaml")],
+        capture_output=True, text=True, env=env, cwd=str(root))
+    # honest exit: one deletion failed
+    assert p.returncode != 0, p.stdout[-1500:]
+    left = sorted(f.name for f in root.glob("tpu-inventory-*.ini"))
+    assert left == ["tpu-inventory-vm-stuck.ini"], p.stdout[-1500:]
+    # per-VM details removed only for cleaned VMs
+    details = sorted(f.name for f in root.glob("tpu-instance-*-details.txt"))
+    assert details == ["tpu-instance-vm-stuck-details.txt"]
+    # per-VM outcomes journaled to the deploy state file
+    sf = state.newest("tpu-deploy-state-*.json", str(root))
+    outcomes = {c["vm"]: c["outcome"]
+                for c in json.load(open(sf))["cleanup"]}
+    assert outcomes == {"vm-good": "deleted", "vm-gone": "already_absent",
+                        "vm-stuck": "error"}
+
+
+def test_cleanup_rerun_after_repair_clears_everything(cleanup_env):
+    root, dd, env = cleanup_env
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "deploy", "miniansible.py"),
+         str(dd / "cleanup-tpu-vm.yaml")],
+        capture_output=True, text=True, env=env, cwd=str(root))
+    # the VM got deleted out of band (or the API recovered): NOT_FOUND now
+    gcloud = root / "bin" / "gcloud"
+    gcloud.write_text(GCLOUD_STUB.replace(
+        '*delete*vm-stuck*) echo "ERROR: internal error" >&2; exit 1;;',
+        '*delete*vm-stuck*) echo "ERROR: NOT_FOUND" >&2; exit 1;;'))
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "deploy", "miniansible.py"),
+         str(dd / "cleanup-tpu-vm.yaml")],
+        capture_output=True, text=True, env=env, cwd=str(root))
+    assert p.returncode == 0, p.stdout[-1500:]
+    assert not list(root.glob("tpu-inventory-*.ini"))
+    assert not list(root.glob("tpu-instance-*-details.txt"))
+
+
+def test_cleanup_playbook_never_removes_unjournaled_inventory():
+    """Structural guard: the inventory-removal task must be outcome-gated
+    (a failed deletion keeps its inventory), and deletion must not abort
+    the loop."""
+    text = open(os.path.join(REPO, "deploy", "cleanup-tpu-vm.yaml")).read()
+    assert "failed_when: false" in text
+    assert "item.1 != 'error'" in text
+    assert "record-cleanup" in text

@@ -257,22 +257,34 @@ def test_sigterm_drains_and_exits_zero_with_streams_intact():
             pytest.fail("server subprocess never became healthy")
 
         result = {}
+        first_chunk = threading.Event()
+        budget = 110     # near the tiny model's max_len=128 window: long
+                         # enough that SIGTERM (sent at the FIRST chunk,
+                         # not after a fixed sleep) lands mid-decode even
+                         # on a fast idle machine — the old fixed 1s sleep
+                         # raced a sub-second stream: the drain exited
+                         # before the 503 probe, which then saw an RST
 
         def client():
             body = json.dumps({"model": "tiny-qwen3", "prompt": "drain me",
-                               "max_tokens": 100, "stream": True,
+                               "max_tokens": budget, "stream": True,
                                "ignore_eos": True}).encode()
             req = urllib.request.Request(
                 f"http://127.0.0.1:{port}/v1/completions", data=body,
                 headers={"Content-Type": "application/json"})
+            chunks = []
             with urllib.request.urlopen(req, timeout=120) as r:
-                result["raw"] = r.read().decode()
+                for line in r:
+                    chunks.append(line.decode())
+                    first_chunk.set()
+            result["raw"] = "".join(chunks)
 
         t = threading.Thread(target=client, daemon=True)
         t.start()
-        time.sleep(1.0)              # stream is mid-decode
+        # synchronize on the stream ACTUALLY decoding, then signal at once
+        assert first_chunk.wait(60), "stream produced no output"
         proc.send_signal(signal.SIGTERM)
-        time.sleep(0.3)
+        time.sleep(0.05)             # let the handler arm the drain flag
         # a NEW request during the drain is shed with the routable 503
         code, _, hdrs = _post(f"http://127.0.0.1:{port}/v1/completions",
                               {"model": "tiny-qwen3", "prompt": "new",
@@ -289,7 +301,7 @@ def test_sigterm_drains_and_exits_zero_with_streams_intact():
         assert finish == ["length"]
         n_ids = sum(len(c.get("token_ids") or []) for o in fins
                     for c in o.get("choices", []))
-        assert n_ids == 100
+        assert n_ids == budget
         rc = proc.wait(timeout=40)
         assert rc == 0, f"exit code {rc}"
     finally:

@@ -1,0 +1,322 @@
+"""miniansible failure classification, deterministic backoff, and chaos
+injection (r9 tentpole part 2 + satellite test coverage).
+
+The self-healing deploy rides on the executor tagging every module failure
+transient (worth retrying/resuming) or fatal (fail fast, record why), and
+on the retry schedule being DETERMINISTIC — capped jittered exponential
+derived from a hash, scaled by MINI_ANSIBLE_DELAY_SCALE — so rehearsals
+and chaos tests see identical behavior on every run."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "deploy"))
+
+import miniansible  # noqa: E402
+
+
+@pytest.fixture()
+def runner(tmp_path):
+    def make(playbook_text, extra=None):
+        pb = tmp_path / "play.yaml"
+        pb.write_text(textwrap.dedent(playbook_text))
+        return miniansible.Runner(str(pb), None, extra or {},
+                                  str(tmp_path / "journal.jsonl"))
+    return make
+
+
+def journal(tmp_path):
+    return [json.loads(ln) for ln in open(str(tmp_path / "journal.jsonl"))]
+
+
+# -- classification table ----------------------------------------------------
+
+
+@pytest.mark.parametrize("res,want", [
+    # transient: connection/DNS/timeout/quota/lock patterns
+    ({"rc": 1, "stderr": "curl: (7) Failed to connect: Connection refused"},
+     "transient"),
+    ({"rc": 1, "stderr": "ssh: connect to host 1.2.3.4: Connection timed out"},
+     "transient"),
+    ({"rc": 1, "stderr": "Could not resolve host: storage.googleapis.com"},
+     "transient"),
+    ({"rc": 1, "stderr": "Temporary failure in name resolution"},
+     "transient"),
+    ({"rc": 1, "stderr": "ERROR: Quota 'TPUS_PER_PROJECT' exceeded"},
+     "transient"),
+    ({"rc": 1, "stderr": "google.api_core: 429 RESOURCE_EXHAUSTED"},
+     "transient"),
+    ({"rc": 1, "stderr": "E: Could not get lock /var/lib/dpkg/lock-frontend"},
+     "transient"),
+    ({"rc": 1, "stderr": "The node was unreachable"}, "transient"),
+    ({"rc": 1, "stderr": "server returned HTTP 503"}, "transient"),
+    # transient: retryable rc with no matching text
+    ({"rc": 100, "stderr": "E: apt failed"}, "transient"),
+    ({"rc": 124, "stderr": ""}, "transient"),
+    ({"rc": 28, "stderr": "curl: (28) op x"}, "transient"),
+    # fatal: config/auth/logic errors
+    ({"rc": 1, "stderr": "ERROR: (gcloud.auth) You do not currently have "
+                         "an active account selected."}, "fatal"),
+    ({"rc": 2, "stderr": "unrecognized arguments: --bogus"}, "fatal"),
+    ({"rc": 1, "stderr": "Permission denied (publickey)"}, "fatal"),
+    ({"rc": 127, "stderr": "kubectl: command not found"}, "fatal"),
+    ({"msg": "assert failed", "rc": None}, "fatal"),
+])
+def test_classification_table(res, want):
+    cls, reason = miniansible.classify_failure(res)
+    assert cls == want, (res, cls, reason)
+    assert reason
+
+
+def test_classification_reason_is_specific():
+    cls, reason = miniansible.classify_failure(
+        {"rc": 1, "stderr": "ERROR: Quota 'TPUS_PER_PROJECT' exceeded"})
+    assert cls == "transient" and "Quota" in reason
+    cls, reason = miniansible.classify_failure(
+        {"rc": 1, "stderr": "line1\nPermission denied (publickey)"})
+    assert cls == "fatal" and "Permission denied" in reason
+
+
+# -- deterministic backoff schedule ------------------------------------------
+
+
+def test_backoff_schedule_deterministic_and_exponential():
+    a = miniansible.backoff_schedule(2.0, 5, seed="task-x")
+    b = miniansible.backoff_schedule(2.0, 5, seed="task-x")
+    assert a == b                               # hash-jitter, not RNG
+    c = miniansible.backoff_schedule(2.0, 5, seed="task-y")
+    assert a != c                               # per-task decorrelation
+    # exponential base progression survives the +/-25% jitter window
+    for i, d in enumerate(a):
+        base = 2.0 * (2.0 ** i)
+        assert 0.75 * base <= d <= 1.25 * base, (i, d)
+
+
+def test_backoff_schedule_caps():
+    sched = miniansible.backoff_schedule(10.0, 8, seed="s", cap=30.0)
+    assert max(sched) <= 30.0 * 1.25
+    assert sched[-1] >= 30.0 * 0.75              # pinned at the cap
+
+
+def test_backoff_sleeps_honor_delay_scale(runner, tmp_path, monkeypatch):
+    """The rehearsal delay-scale knob compresses the REAL slept schedule;
+    the journal records the scaled values — asserting both the schedule
+    shape and that a rehearsal run cannot stall on backoff."""
+    monkeypatch.setattr(miniansible, "DELAY_SCALE", 0.01)
+    marker = tmp_path / "n"
+    r = runner("""
+    - hosts: localhost
+      gather_facts: false
+      tasks:
+        - name: flaky mirror
+          ansible.builtin.shell: |
+            n=$(cat %s 2>/dev/null || echo 0); n=$((n+1)); echo "$n" > %s
+            if [ "$n" -lt 3 ]; then echo "Connection timed out" >&2; exit 7; fi
+            echo recovered
+          retries: 4
+          delay: 2
+    """ % (marker, marker))
+    r.run_playbook()
+    assert r.stats["failed"] == 0
+    [rec] = [x for x in journal(tmp_path) if x["task"] == "flaky mirror"]
+    assert rec["attempts"] == 3
+    assert rec["failed"] is False
+    assert rec["failure_class"] == "transient"      # what it survived
+    expect = [round(d * 0.01, 4)
+              for d in miniansible.backoff_schedule(2.0, 5,
+                                                    seed="flaky mirror")[:2]]
+    assert rec["backoff_s"] == expect
+    assert rec["backoff_s"][1] > rec["backoff_s"][0]
+
+
+# -- retry semantics ---------------------------------------------------------
+
+
+def test_transient_failure_retries_without_explicit_retries(runner, tmp_path,
+                                                            monkeypatch):
+    """A flaky task with NO `retries:` still gets the module-default
+    transient retries (a transient apt mirror blip must not abort L2)."""
+    monkeypatch.setattr(miniansible, "DELAY_SCALE", 0.001)
+    marker = tmp_path / "n"
+    r = runner("""
+    - hosts: localhost
+      gather_facts: false
+      tasks:
+        - name: one blip
+          ansible.builtin.shell: |
+            if [ ! -e %s ]; then touch %s; echo "Connection reset by peer" >&2; exit 1; fi
+            echo ok
+    """ % (marker, marker))
+    r.run_playbook()
+    assert r.stats["failed"] == 0
+    [rec] = journal(tmp_path)
+    assert rec["attempts"] == 2
+
+
+def test_fatal_failure_fails_fast_despite_retries(runner, tmp_path,
+                                                  monkeypatch):
+    """retries: 5 on a task that fails FATALLY (bad flag) must not burn
+    five attempts — fail fast with the classified reason journaled."""
+    monkeypatch.setattr(miniansible, "DELAY_SCALE", 0.001)
+    r = runner("""
+    - hosts: localhost
+      gather_facts: false
+      tasks:
+        - name: misconfigured
+          ansible.builtin.shell: 'echo "unrecognized arguments: --frob" >&2; exit 2'
+          retries: 5
+    """)
+    with pytest.raises(miniansible.TaskFailed):
+        r.run_playbook()
+    [rec] = journal(tmp_path)
+    assert rec["failed"] is True
+    assert rec["attempts"] == 1                     # no useless retries
+    assert rec["failure_class"] == "fatal"
+    assert "unrecognized arguments" in rec["failure_reason"]
+
+
+def test_fatal_breaks_until_loop_early(runner, tmp_path, monkeypatch):
+    monkeypatch.setattr(miniansible, "DELAY_SCALE", 0.001)
+    marker = tmp_path / "n"
+    r = runner("""
+    - hosts: localhost
+      gather_facts: false
+      tasks:
+        - name: poll that hits a fatal error
+          ansible.builtin.shell: |
+            n=$(cat %s 2>/dev/null || echo 0); echo $((n+1)) > %s
+            echo "Permission denied (publickey)" >&2; exit 255
+          register: out
+          until: out.rc == 0
+          retries: 10
+          delay: 1
+    """ % (marker, marker))
+    with pytest.raises(miniansible.TaskFailed):
+        r.run_playbook()
+    assert marker.read_text().strip() == "1"        # one attempt, not ten
+    [rec] = journal(tmp_path)
+    assert rec["failure_class"] == "fatal"
+
+
+def test_transient_keeps_polling_until_loop(runner, tmp_path, monkeypatch):
+    """An until-loop whose command fails TRANSIENTLY keeps polling (the
+    wait-for-READY contract survives flaky describes)."""
+    monkeypatch.setattr(miniansible, "DELAY_SCALE", 0.001)
+    marker = tmp_path / "n"
+    r = runner("""
+    - hosts: localhost
+      gather_facts: false
+      tasks:
+        - name: flaky poll
+          ansible.builtin.shell: |
+            n=$(cat %s 2>/dev/null || echo 0); n=$((n+1)); echo "$n" > %s
+            if [ "$n" -lt 3 ]; then echo "Connection refused" >&2; exit 7; fi
+            echo READY
+          register: out
+          until: out.stdout == "READY"
+          retries: 6
+          delay: 1
+    """ % (marker, marker))
+    r.run_playbook()
+    assert r.stats["failed"] == 0
+    assert marker.read_text().strip() == "3"
+
+
+# -- deterministic chaos injection -------------------------------------------
+
+
+def test_chaos_parse_and_validation():
+    specs = miniansible.parse_chaos("apt:transient:2; render:fatal")
+    assert [(s.pattern, s.kind, s.times) for s in specs] == \
+        [("apt", "transient", 2), ("render", "fatal", 1)]
+    with pytest.raises(ValueError):
+        miniansible.parse_chaos("apt:flaky")
+
+
+def test_chaos_transient_retries_then_succeeds(runner, tmp_path,
+                                               monkeypatch):
+    monkeypatch.setattr(miniansible, "DELAY_SCALE", 0.001)
+    monkeypatch.setenv("MINI_ANSIBLE_CHAOS", "flaky step:transient:2")
+    r = runner("""
+    - hosts: localhost
+      gather_facts: false
+      tasks:
+        - name: flaky step
+          ansible.builtin.shell: echo fine
+          register: out
+        - name: untouched step
+          ansible.builtin.shell: echo also-fine
+    """)
+    r.run_playbook()
+    assert r.stats["failed"] == 0
+    recs = journal(tmp_path)
+    flaky = next(x for x in recs if x["task"] == "flaky step")
+    assert flaky["attempts"] == 3                   # 2 injected + 1 real
+    assert flaky["chaos"] == "transient"
+    assert flaky["failure_class"] == "transient"
+    assert len(flaky["backoff_s"]) == 2
+    other = next(x for x in recs if x["task"] == "untouched step")
+    assert other["attempts"] == 1 and "chaos" not in other
+
+
+def test_chaos_fatal_stops_playbook_with_classified_journal(runner, tmp_path,
+                                                            monkeypatch):
+    monkeypatch.setenv("MINI_ANSIBLE_CHAOS", "doomed:fatal")
+    r = runner("""
+    - hosts: localhost
+      gather_facts: false
+      tasks:
+        - name: doomed step
+          ansible.builtin.shell: echo never-runs
+        - name: unreached step
+          ansible.builtin.shell: echo nope
+    """)
+    with pytest.raises(miniansible.TaskFailed):
+        r.run_playbook()
+    recs = journal(tmp_path)
+    assert [x["task"] for x in recs] == ["doomed step"]   # stopped there
+    assert recs[0]["failure_class"] == "fatal"
+    assert recs[0]["chaos"] == "fatal"
+    assert "chaos" in recs[0]["failure_reason"]
+
+
+# -- looped-register semantics the cleanup playbook relies on ----------------
+
+
+def test_looped_register_always_has_results_with_items(runner, tmp_path):
+    r = runner("""
+    - hosts: localhost
+      gather_facts: false
+      tasks:
+        - name: single-item loop
+          ansible.builtin.shell: echo "{{ item }}"
+          loop: [only]
+          register: out
+        - ansible.builtin.assert:
+            that:
+              - out.results | length == 1
+              - out.results[0].stdout == "only"
+              - out.results[0].item == "only"
+    """)
+    r.run_playbook()
+    assert r.stats["failed"] == 0
+
+
+def test_looped_set_fact_accumulates(runner, tmp_path):
+    r = runner("""
+    - hosts: localhost
+      gather_facts: false
+      tasks:
+        - ansible.builtin.set_fact:
+            acc: "{{ (acc | default([])) + [item * 2] }}"
+          loop: [1, 2, 3]
+        - ansible.builtin.assert:
+            that: acc == [2, 4, 6]
+    """)
+    r.run_playbook()
+    assert r.stats["failed"] == 0

@@ -92,6 +92,24 @@ def test_rehearsal_render_multi_replica():
     assert eng_svc["metadata"]["name"] in joined
 
 
+def test_rollout_strategy_matches_substrate():
+    """r9: reconciler rolling restarts need an EXPLICIT strategy. CPU
+    rehearsal surges first (strict zero downtime); TPU pods restart in
+    place (a surge pod could never schedule — every chip is allocated —
+    and the k8s default 25%-surge would deadlock the rollout)."""
+    def strat(**overrides):
+        docs = _render(**overrides)
+        eng = next(d for d in docs if d["kind"] == "Deployment"
+                   and d["metadata"]["name"] == "tpu-serving-engine")
+        assert eng["spec"]["strategy"]["type"] == "RollingUpdate"
+        return eng["spec"]["strategy"]["rollingUpdate"]
+
+    assert strat(rehearsal_cpu=True, model="tiny-qwen3", framework_image="i",
+                 storage_class="standard") == \
+        {"maxUnavailable": 0, "maxSurge": 1}
+    assert strat() == {"maxUnavailable": 1, "maxSurge": 0}
+
+
 def test_rehearsal_script_bash_clean():
     subprocess.run(["bash", "-n", str(REPO / "deploy" / "rehearse-kind.sh")],
                    check=True)

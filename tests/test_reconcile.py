@@ -1,0 +1,238 @@
+"""Reconciler tests (r9 tentpole part 3): layer probes, first-broken
+ordering, the in-place undrain repair, the reconcile smoke script, and the
+headline scenario — a ROLLING RESTART of every serving replica under live
+load with zero failed requests and byte-identical seeded streams (the
+ROADMAP "multi-replica drain chaos at scale" item; the kind-cluster
+variant lives in deploy/rehearse-kind.sh, this is the same machinery
+against real in-process engines).
+
+Wired into tier-1 via the `reconcile_smoke` marker (`make reconcile-smoke`).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "deploy"))
+
+import probes  # noqa: E402
+
+from aws_k8s_ansible_provisioner_tpu.config import (  # noqa: E402
+    ServingConfig, tiny_qwen3)
+from aws_k8s_ansible_provisioner_tpu.models.layers import (  # noqa: E402
+    init_params)
+from aws_k8s_ansible_provisioner_tpu.serving.router import (  # noqa: E402
+    BackendPool, RouterHandler, RouterMetrics, start_load_poller)
+from aws_k8s_ansible_provisioner_tpu.serving.server import (  # noqa: E402
+    build_state, serve)
+from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import (  # noqa: E402
+    ByteTokenizer)
+
+MODEL_NAME = "tiny-qwen3"
+
+
+# -- probe unit tests --------------------------------------------------------
+
+
+def test_parse_inventory_vm(tmp_path):
+    inv = tmp_path / "tpu-inventory-tpu-llm-77.ini"
+    inv.write_text("[tpu_instances]\n1.2.3.4 tpu_name=tpu-llm-77\n"
+                   "[tpu_instances:vars]\ntpu_zone=us-east5-b\n"
+                   "tpu_project=proj-1\n")
+    vm = probes.parse_inventory_vm(str(inv))
+    assert vm == {"name": "tpu-llm-77", "zone": "us-east5-b",
+                  "project": "proj-1"}
+    # filename fallback when the content carries no tpu_name
+    inv2 = tmp_path / "tpu-inventory-fallback-9.ini"
+    inv2.write_text("[tpu_instances]\n1.2.3.4\n")
+    assert probes.parse_inventory_vm(str(inv2))["name"] == "fallback-9"
+
+
+def test_first_broken_ordering():
+    rs = [probes.ProbeResult("L1", True, ""),
+          probes.ProbeResult("L2", False, "node NotReady"),
+          probes.ProbeResult("L3", False, "replica down")]
+    assert probes.first_broken(rs) == "L2"
+    assert probes.first_broken([probes.ProbeResult("L1", True, "")]) is None
+
+
+def test_probe_l1_without_inventory():
+    r = probes.probe_l1({}, None)
+    assert not r.ok and "inventory" in r.detail
+
+
+class _FakeReplica(BaseHTTPRequestHandler):
+    """Minimal replica: /readyz 503 draining until /admin/undrain."""
+    draining = True
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.path == "/readyz":
+            code = 503 if type(self).draining else 200
+            body = json.dumps({"status": "draining"
+                               if type(self).draining else "ok"}).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    def do_POST(self):
+        if self.path == "/admin/undrain":
+            type(self).draining = False
+        self.send_response(200)
+        self.send_header("Content-Length", "2")
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+
+def test_probe_l3_and_undrain_repair(monkeypatch):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeReplica)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        addr = f"127.0.0.1:{srv.server_port}"
+        monkeypatch.setenv("TPU_PROBE_REPLICAS", addr)
+        _FakeReplica.draining = True
+        r = probes.probe_l3({}, None)
+        assert not r.ok and "503" in r.detail
+        # the cheap repair: undrain in place, then the probe passes
+        assert probes.repair_l3_undrain({}, None, log=lambda *_: None)
+        assert probes.probe_l3({}, None).ok
+    finally:
+        srv.shutdown()
+
+
+def test_probe_l5_override(monkeypatch):
+    monkeypatch.setenv("TPU_PROBE_COLLECTOR", "http://127.0.0.1:1/healthz")
+    assert not probes.probe_l5({}, None).ok
+
+
+# -- the reconcile smoke script (orchestrator-level) -------------------------
+
+
+def _can_unshare() -> bool:
+    try:
+        return subprocess.run(["unshare", "--mount", "true"],
+                              capture_output=True, timeout=10).returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+@pytest.mark.reconcile_smoke
+def test_reconcile_smoke_script():
+    if not _can_unshare():
+        pytest.skip("unshare --mount unavailable (needs privileges)")
+    p = subprocess.run(
+        ["bash", os.path.join(REPO, "deploy", "reconcile-smoke.sh")],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "SMOKE_ENGINE_PORT": "18685",
+             "SMOKE_ROUTER_PORT": "18686"})
+    tail = (p.stdout + p.stderr)[-4000:]
+    assert p.returncode == 0, tail
+    assert '"ok": true' in p.stdout.splitlines()[-1], tail
+    for needle in ("nothing to reconcile", "undrained the replica",
+                   "re-ran the L5 playbook", "unrepaired probe"):
+        assert needle in p.stdout, f"missing {needle!r} in:\n{tail}"
+
+
+# -- rolling restart under live load -----------------------------------------
+
+
+def _start_engine(port):
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    serving = ServingConfig(weights_dtype="bf16", model=MODEL_NAME,
+                            max_decode_slots=4, max_cache_len=128,
+                            prefill_buckets=(16, 32, 64), dtype="float32")
+    state = build_state(serving, model_cfg=cfg, params=params, tokenizer=tok)
+    ready, stop = threading.Event(), threading.Event()
+    t = threading.Thread(target=serve,
+                         args=(state, "127.0.0.1", port, ready, stop),
+                         daemon=True)
+    t.start()
+    assert ready.wait(60)
+    return state, stop
+
+
+@pytest.mark.reconcile_smoke
+def test_rolling_restart_under_load_zero_failures():
+    """The reconciler restarts EVERY serving replica (drain → quiesce →
+    restart → /readyz → undrain) while a concurrent seeded client load
+    loop runs through the real router. Zero non-2xx responses, zero
+    truncated streams, and every seeded stream token-identical to its
+    reference (the PR 3 failover/drain guarantees composed end-to-end)."""
+    ports = [18690, 18691]
+    engines = {p: _start_engine(p) for p in ports}
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    old = RouterHandler.pool, RouterHandler.metrics
+    RouterHandler.pool = BackendPool(",".join(addrs), cooldown_s=2.0)
+    RouterHandler.metrics = RouterMetrics()
+    poll_stop = threading.Event()
+    start_load_poller(RouterHandler.pool, interval_s=0.1, stop=poll_stop)
+    router = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    gw = f"127.0.0.1:{router.server_port}"
+
+    def restart(addr):
+        port = int(addr.rsplit(":", 1)[1])
+        _, stop = engines[port]
+        stop.set()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:      # wait for the port to free
+            try:
+                urllib.request.urlopen(f"http://{addr}/healthz", timeout=1)
+                time.sleep(0.1)
+            except OSError:
+                break
+        engines[port] = _start_engine(port)
+
+    load_stop = threading.Event()
+    counters = {}
+
+    def load():
+        counters.update(probes.run_load(gw, MODEL_NAME, load_stop,
+                                        concurrency=2, max_tokens=12))
+
+    load_thread = threading.Thread(target=load, daemon=True)
+    try:
+        load_thread.start()
+        time.sleep(1.0)                          # references established
+        probes.rolling_restart(addrs, restart, drain_timeout_s=30.0,
+                               poll_s=0.05, log=lambda *_: None)
+        time.sleep(0.5)                          # a last post-restart lap
+        load_stop.set()
+        load_thread.join(timeout=120)
+        assert not load_thread.is_alive()
+        assert counters["requests"] >= 8, counters
+        assert counters["non_2xx"] == 0, counters
+        assert counters["incomplete_streams"] == 0, counters
+        assert counters["stream_mismatches"] == 0, counters
+        # both replicas really did restart and are back in rotation
+        for addr in addrs:
+            with urllib.request.urlopen(f"http://{addr}/readyz",
+                                        timeout=5) as r:
+                assert r.status == 200
+        # fresh engines: slot accounting clean after the dust settles
+        for port in ports:
+            st = engines[port][0].engine.sched.stats()
+            assert st.active_slots == 0 and st.queue_depth == 0, st
+    finally:
+        load_stop.set()
+        poll_stop.set()
+        router.shutdown()
+        for _, stop in engines.values():
+            stop.set()
+        RouterHandler.pool, RouterHandler.metrics = old
