@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests \
-	overload-smoke resume-smoke reconcile-smoke
+	overload-smoke resume-smoke reconcile-smoke trace-smoke
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -58,6 +58,16 @@ resume-smoke:
 # reconcile_smoke).
 reconcile-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m reconcile_smoke \
+		-p no:cacheprovider
+
+# Tracing smoke (serving/tracing.py): a hermetic in-process fake OTLP
+# collector receives the full span tree from REAL router→server→engine
+# requests (streamed + unary) — root span, per-hop dispatch spans
+# (failover/429-retry included), server request span, five monotonic
+# non-overlapping phase children — and a killed exporter changes no request
+# outcome. Tier-1 runs these too (marker trace_smoke).
+trace-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m trace_smoke \
 		-p no:cacheprovider
 
 # kubeconform (when installed) + structural validation over every rendered

@@ -719,6 +719,17 @@ class ServingConfig:
     # latency for running streams. 0 disables the floor (pure prefill
     # priority, the pre-r4 behavior).
     prefill_fairness: int = 4
+    # ---- request tracing (serving/tracing.py) ----
+    # OTLP/HTTP trace collector base URL (spans POST to <endpoint>/v1/traces).
+    # Empty falls back to $OTEL_EXPORTER_OTLP_ENDPOINT — which the serving
+    # manifest sets from ansible_vars' otlp_endpoint (the deployed Tempo's
+    # OTLP receiver) — and when neither is set spans are created (trace ids
+    # still echo in responses/errors for log correlation) but never exported.
+    otlp_endpoint: str = ""
+    # Root-span sampling probability in [0, 1]. Propagated contexts inherit
+    # the caller's decision (W3C parent-based sampling), so the router's
+    # knob effectively governs the whole tree.
+    trace_sample: float = 1.0
     # Seed for the engine's DERIVED sampling seeds (requests without an
     # OpenAI ``seed``). None = entropy from os.urandom at engine start, so
     # restarts and replicas draw independently (the vLLM/OpenAI
@@ -851,6 +862,15 @@ def ansible_vars(cfg: FrameworkConfig | None = None,
     # Replica lifecycle (r8): the preStop hook, terminationGracePeriodSeconds
     # and the engine's --drain-timeout all derive from this one knob.
     d["serving_drain_timeout_s"] = cfg.serving.drain_timeout_s
+    # Request tracing: the manifest exports this as
+    # OTEL_EXPORTER_OTLP_ENDPOINT on the engine and router containers.
+    # Default = the deployed Tempo Service's own OTLP/HTTP receiver
+    # (otel-observability-setup.yaml exposes 4318 on the ``tempo`` Service),
+    # so spans light up the trace backend with no extra wiring.
+    d["otlp_endpoint"] = (cfg.serving.otlp_endpoint
+                          or f"http://tempo.{cfg.deploy.otel_namespace}"
+                             ".svc.cluster.local:4318")
+    d["serving_trace_sample"] = cfg.serving.trace_sample
     # --set overrides (rehearsals pin model/ports); unknown keys pass
     # through — the playbooks treat group_vars as an open namespace
     d.update(overrides or {})

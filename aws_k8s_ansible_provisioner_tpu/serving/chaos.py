@@ -37,6 +37,12 @@ fault                       defined degradation behavior
                             from the backend raises after ``after_events``
                             relayed events — drives the failover path
                             without any server cooperation
+``span_export``             the OTLP trace collector misbehaves — refuses
+                            connections, hangs, or answers 5xx (``mode``) —
+                            only the exporter's background thread sees it:
+                            requests succeed unchanged and the spans are
+                            dropped and counted
+                            (``tpu_serve_spans_dropped_total``)
 ``deadline``                (engine-native, no injection needed) request
                             past its deadline is cancelled, slot/pages
                             released, client gets 408 deadline_exceeded
@@ -70,7 +76,7 @@ from typing import Dict, Optional
 
 FAULTS = ("connect_refused", "stalled_decode", "page_exhaustion",
           "slow_client", "mid_stream_disconnect", "kill_stream",
-          "stream_read_error")
+          "stream_read_error", "span_export")
 
 
 class InjectedFault(RuntimeError):
@@ -269,6 +275,28 @@ class ChaosController:
             return
         raise ConnectionResetError(f"chaos: injected mid-stream read "
                                    f"failure from backend {addr}")
+
+    def on_span_export(self) -> None:
+        """tracing.OTLPHTTPExporter._send entry (exporter background thread
+        ONLY — never a request thread): an armed ``span_export`` makes the
+        trace collector misbehave per ``mode``: ``refuse`` (default) raises
+        the ConnectionRefusedError of a dead collector; ``hang`` sleeps
+        ``hang_s`` (default 5.0, standing in for a wedged endpoint — still
+        on the background thread, so request latency is untouched) then
+        raises; ``5xx`` models a collector that answers but rejects. All
+        three must resolve to dropped-and-counted spans, never a failed or
+        stalled request — tests/test_tracing.py asserts that contract."""
+        p = self.fire("span_export")
+        if p is None:
+            return
+        mode = str(p.get("mode", "refuse"))
+        if mode == "hang":
+            time.sleep(float(p.get("hang_s", 5.0)))
+            raise OSError("chaos: span export hung, then timed out")
+        if mode == "5xx":
+            raise InjectedFault("chaos: trace collector answered 503")
+        raise ConnectionRefusedError("chaos: trace collector refused "
+                                     "connection")
 
 
 _controller: Optional[ChaosController] = None

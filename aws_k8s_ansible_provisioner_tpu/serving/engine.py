@@ -187,6 +187,10 @@ class Request:
     prompt_logprob_data: List = field(default_factory=list)
     out_queue: "queue.Queue" = field(default_factory=queue.Queue)
     t_submit: float = 0.0
+    # first admission out of the queue into a slot (set-if-unset, so a
+    # preempt/requeue round-trip keeps the original queue-wait boundary) —
+    # splits TTFT into queue-wait vs prefill for the tracing phase spans
+    t_prefill_start: float = 0.0
     t_first_token: float = 0.0
     t_done: float = 0.0
     finish_reason: str = ""
@@ -1942,6 +1946,8 @@ class Engine:
             if req is None:  # should not happen; free the slot defensively
                 self.sched.release(slot)
                 continue
+            if not req.t_prefill_start:
+                req.t_prefill_start = time.monotonic()
             if self.paged:
                 isolated = (not batch
                             and self.sched.stats().queue_depth == 0)

@@ -47,6 +47,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
+from aws_k8s_ansible_provisioner_tpu.serving import tracing
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import (
     Counter, Gauge, Registry)
 
@@ -516,12 +517,27 @@ def _continuation_body(fo: dict, st: dict) -> bytes:
 class RouterHandler(BaseHTTPRequestHandler):
     pool: BackendPool = None       # injected by serve()
     metrics: RouterMetrics = None  # injected by serve()
+    tracer: tracing.Tracer = None  # injected by serve(); None = no spans
     protocol_version = "HTTP/1.1"
+    # Per-request trace state (class defaults so keep-alive connections
+    # never leak a previous request's spans into the next).
+    _root_span = None
+    _hop_span = None
+    _trace_ctx = None
+    _next_kind = "first"
 
     def log_message(self, fmt, *args):  # quiet; structured logging below
         log.debug(fmt, *args)
 
     def _respond_json(self, code: int, obj: dict):
+        if self._trace_ctx is not None and isinstance(obj.get("error"),
+                                                      dict):
+            # log correlation on gateway-originated errors (408/429/502/
+            # 503): the ids to look the request up in Tempo
+            obj["error"].setdefault("trace_id", self._trace_ctx.trace_id)
+            obj["error"].setdefault("span_id", self._trace_ctx.span_id)
+        if self._root_span is not None:
+            self._root_span.set_attribute("http.status_code", code)
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -529,7 +545,65 @@ class RouterHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    # -- dispatch-hop span plumbing ------------------------------------------
+    # One "router.dispatch" child span per attempt at a backend. The loop
+    # body only ever calls _hop_begin at the attempt's top and _hop_end at
+    # each branch that settles the attempt — ``next_kind`` names what the
+    # FOLLOWING attempt will be (failover / retry_429 / stream_continuation),
+    # which is how the golden span-tree test tells a 429 retry hop from a
+    # connect failover hop.
+
+    def _hop_begin(self, addr: str, index: int):
+        if self._root_span is None:
+            return
+        self._hop_span = self.tracer.start_span(
+            "router.dispatch", parent=self._root_span.context,
+            kind=tracing.KIND_CLIENT,
+            attributes={"backend.addr": addr, "dispatch.index": index,
+                        "dispatch.kind": self._next_kind})
+
+    def _hop_attr(self, key: str, value):
+        if self._hop_span is not None:
+            self._hop_span.set_attribute(key, value)
+
+    def _hop_end(self, outcome: str = "", next_kind: str = ""):
+        if self._hop_span is not None:
+            if outcome:
+                self._hop_span.set_attribute("dispatch.outcome", outcome)
+            self.tracer.finish(self._hop_span)
+            self._hop_span = None
+        if next_kind:
+            self._next_kind = next_kind
+
     def _proxy(self, method: str):
+        """Root-span wrapper around the dispatch loop: opens (or continues,
+        when the client sent a ``traceparent``) the trace whose child hops
+        the loop emits, and guarantees both the dangling hop and the root
+        are finished however the loop exits."""
+        tracer = self.tracer
+        if tracer is None or self.path in ("/health", "/metrics"):
+            return self._proxy_impl(method)
+        parent = tracing.parse_traceparent(
+            self.headers.get(tracing.TRACEPARENT_HEADER))
+        self._root_span = tracer.start_span(
+            "router.request", parent=parent, kind=tracing.KIND_SERVER,
+            attributes={"http.method": method,
+                        "http.target": self.path.split("?")[0]})
+        self._trace_ctx = self._root_span.context
+        self._hop_span = None
+        self._next_kind = "first"
+        try:
+            return self._proxy_impl(method)
+        except Exception as e:
+            self._root_span.error(f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            self._hop_end()
+            tracer.finish(self._root_span)
+            self._root_span = None
+            self._trace_ctx = None
+
+    def _proxy_impl(self, method: str):
         if self.path == "/health":
             now = time.monotonic()
             with self.pool._lock:
@@ -556,7 +630,8 @@ class RouterHandler(BaseHTTPRequestHandler):
             # The router's OWN counters (not proxied): the engine pods are
             # scraped directly by pod discovery; this route makes the gateway
             # itself visible to L5.
-            body = self.metrics.registry.render().encode()
+            body = (self.metrics.registry.render()
+                    + tracing.metrics.registry.render()).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))
@@ -613,12 +688,20 @@ class RouterHandler(BaseHTTPRequestHandler):
             if i > 0 and not fo_state["headers_sent"]:
                 self.metrics.failovers.inc()
             hdrs2 = dict(hdrs)
+            self._hop_begin(addr, i)
+            if self._hop_span is not None:
+                # the hop span IS the backend's parent: the server's
+                # request span hangs off this dispatch attempt, so a
+                # failover's two attempts stay distinguishable in Tempo
+                hdrs2[tracing.TRACEPARENT_HEADER] = \
+                    tracing.format_traceparent(self._hop_span.context)
             read_to = READ_TIMEOUT_S
             if ddl_ms is not None:
                 rem_ms = ddl_ms - (time.monotonic() - t_start) * 1000.0
                 if rem_ms <= 0:
                     # deadline burnt inside the gateway: answering now beats
                     # dispatching work the backend must immediately expire
+                    self._hop_end("deadline_exhausted")
                     if fo_state["headers_sent"]:
                         self.close_connection = True
                         return
@@ -629,6 +712,10 @@ class RouterHandler(BaseHTTPRequestHandler):
                         "type": "timeout", "code": "deadline_exceeded"}})
                     return
                 hdrs2[DEADLINE_HEADER] = str(int(max(1.0, rem_ms)))
+                # the per-hop remaining budget: the golden span-tree test
+                # asserts this decreases strictly across retry hops
+                self._hop_attr("deadline.remaining_ms",
+                               int(max(1.0, rem_ms)))
                 # the remaining deadline bounds this hop's read timeout too:
                 # the backend answers 408 within it, so waiting the full
                 # READ_TIMEOUT_S past it only pins a router thread
@@ -652,6 +739,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                 self.pool.mark_dead(addr)
                 self.metrics.dead_marks.inc()
                 last_err = e
+                self._hop_end("connect_failed", next_kind="failover")
                 log.warning("backend %s connect failed (%s); trying next",
                             addr, e)
                 continue
@@ -680,11 +768,14 @@ class RouterHandler(BaseHTTPRequestHandler):
                         fo_state["failovers"] += 1
                         self.metrics.stream_failovers.inc()
                         cur_body = _continuation_body(fo, fo_state)
+                        self._hop_end("backend_died",
+                                      next_kind="stream_continuation")
                         log.warning("backend %s died pre-response (%s); "
                                     "re-issuing stream as continuation "
                                     "(%d tokens relayed)", addr, e,
                                     len(fo_state["token_ids"]))
                         continue
+                    self._hop_end("backend_died")
                     log.warning("backend %s failed after accepting a request "
                                 "body (%s); NOT retrying elsewhere", addr, e)
                     if fo_state["headers_sent"]:
@@ -695,6 +786,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                         "message": f"backend failed mid-request: {e}",
                         "type": "router_error"}})
                     return
+                self._hop_end("send_failed", next_kind="failover")
                 log.warning("backend %s failed (%s); trying next", addr, e)
                 continue
             # Phase 2.4: 503 + X-TPU-Draining = the replica shed at
@@ -708,6 +800,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                 self.pool.note_draining(addr)
                 self.metrics.draining_skips.inc()
                 last_err = f"backend {addr} draining"
+                self._hop_end("draining", next_kind="failover")
                 log.info("backend %s draining; trying next", addr)
                 continue
             # Phase 2.5: a 429 means the backend SHED the request at
@@ -722,11 +815,13 @@ class RouterHandler(BaseHTTPRequestHandler):
                 if n_429 < RETRY_429_BUDGET and i < len(candidates) - 1:
                     n_429 += 1
                     self.metrics.retries_429.inc()
+                    self._hop_end("shed_429", next_kind="retry_429")
                     import random as _random
 
                     time.sleep(RETRY_429_BACKOFF_S
                                * (0.5 + _random.random()))
                     continue
+                self._hop_end("shed_429")
                 if fo_state["headers_sent"]:
                     # a continuation shed everywhere: the open stream cannot
                     # become a 429 now — truncate
@@ -753,8 +848,13 @@ class RouterHandler(BaseHTTPRequestHandler):
                 outcome = self._relay_sse(resp, addr, fo_state)
                 conn.close()
                 if outcome == "done":
+                    if self._root_span is not None:
+                        self._root_span.set_attribute("http.status_code",
+                                                      200)
+                    self._hop_end("stream_done")
                     return
                 if outcome == "client_gone":
+                    self._hop_end("client_gone")
                     # client disconnect, NOT a backend failure: no failover,
                     # no dead-mark (the backend cancels via broken pipe)
                     log.info("client disconnected mid-stream")
@@ -766,6 +866,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                         or (fo_state["chars"] and not fo_state["tagged"]):
                     # can't (backend never tagged token ids) or won't
                     # (budget spent) continue: truncate, the pre-r8 behavior
+                    self._hop_end("backend_died")
                     log.warning("backend %s died mid-stream; NOT failing "
                                 "over (tagged=%s, failovers=%d)", addr,
                                 fo_state["tagged"], fo_state["failovers"])
@@ -774,6 +875,8 @@ class RouterHandler(BaseHTTPRequestHandler):
                 fo_state["failovers"] += 1
                 self.metrics.stream_failovers.inc()
                 cur_body = _continuation_body(fo, fo_state)
+                self._hop_end("backend_died",
+                              next_kind="stream_continuation")
                 log.warning("backend %s died mid-stream after %d tokens / "
                             "%d chars; continuing on the next replica",
                             addr, len(fo_state["token_ids"]),
@@ -783,6 +886,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                 # a continuation answered something that isn't a stream
                 # (4xx/5xx app error): the open SSE response cannot change
                 # status — truncate
+                self._hop_end("unexpected_status")
                 conn.close()
                 log.warning("continuation on %s answered %s; truncating "
                             "stream", addr, resp.status)
@@ -795,6 +899,10 @@ class RouterHandler(BaseHTTPRequestHandler):
             # (BrokenPipeError) must NOT mark the backend dead.
             try:
                 self.metrics.requests.inc(code=str(resp.status))
+                if self._root_span is not None:
+                    self._root_span.set_attribute("http.status_code",
+                                                  resp.status)
+                self._hop_attr("http.status_code", resp.status)
                 self.send_response(resp.status)
                 self.send_header("Content-Type", ctype)
                 if "text/event-stream" in ctype:
@@ -828,6 +936,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                 self.close_connection = True
             finally:
                 conn.close()
+            self._hop_end("relayed")
             return
         if fo_state["headers_sent"]:
             # a mid-stream failover ran out of replicas: truncate
@@ -907,9 +1016,13 @@ class RouterHandler(BaseHTTPRequestHandler):
         self._proxy("POST")
 
 
-def serve(backend_service: str, host: str, port: int):
+def serve(backend_service: str, host: str, port: int,
+          otlp_endpoint: str = "", trace_sample: float = 1.0):
     RouterHandler.pool = BackendPool(backend_service)
     RouterHandler.metrics = RouterMetrics()
+    RouterHandler.tracer = tracing.build_tracer(
+        "tpu-serve-router", endpoint=otlp_endpoint or None,
+        sample=trace_sample)
     start_load_poller(RouterHandler.pool, metrics=RouterHandler.metrics)
     httpd = ThreadingHTTPServer((host, port), RouterHandler)
     log.info("router listening on %s:%d -> %s", host, port, backend_service)
@@ -924,8 +1037,15 @@ def main(argv=None):
                    help="host:port of the engine Service (DNS resolved to replicas)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--otlp-endpoint", default="",
+                   help="OTLP/HTTP trace collector base URL; empty falls "
+                        "back to $OTEL_EXPORTER_OTLP_ENDPOINT, neither = "
+                        "spans stay local")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="root-span sampling probability in [0, 1]")
     args = p.parse_args(argv)
-    serve(args.backend_service, args.host, args.port)
+    serve(args.backend_service, args.host, args.port,
+          otlp_endpoint=args.otlp_endpoint, trace_sample=args.trace_sample)
 
 
 if __name__ == "__main__":

@@ -28,6 +28,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
+from aws_k8s_ansible_provisioner_tpu.serving import tracing
 from aws_k8s_ansible_provisioner_tpu.serving.engine import (
     ContextLengthExceeded, EngineOverloaded)
 
@@ -70,6 +71,9 @@ class ServerState:
         self.templater = templater
         self.model_name = model_name
         self.started = _now()
+        # Request tracing (set by build_state from serving config; tests
+        # inject seeded tracers). None = spans off entirely.
+        self.tracer: Optional[tracing.Tracer] = None
         # Serializes /debug/profile captures (one JAX trace at a time).
         self.profile_lock = threading.Lock()
         # Graceful drain (r8): set by serve() so the SIGTERM handler /
@@ -209,6 +213,9 @@ def _apply_stop_strings(text: str, stops: List[str]) -> Optional[str]:
 class Handler(BaseHTTPRequestHandler):
     state: ServerState  # set by serve()
     protocol_version = "HTTP/1.1"
+    # Per-request trace context (class default so keep-alive connections
+    # never leak a previous request's ids into an untraced one).
+    _trace_ctx: Optional[tracing.SpanContext] = None
 
     # -- plumbing -----------------------------------------------------------
 
@@ -229,9 +236,14 @@ class Handler(BaseHTTPRequestHandler):
                err_type: str = "invalid_request_error",
                err_code: Optional[str] = None,
                headers: Optional[dict] = None):
-        self._json(code, {"error": {"message": message, "type": err_type,
-                                    "code": err_code if err_code else code}},
-                   headers=headers)
+        err = {"message": message, "type": err_type,
+               "code": err_code if err_code else code}
+        if self._trace_ctx is not None:
+            # log correlation: the ids to paste into Tempo / grep from the
+            # collector when a request fails
+            err["trace_id"] = self._trace_ctx.trace_id
+            err["span_id"] = self._trace_ctx.span_id
+        self._json(code, {"error": err}, headers=headers)
 
     def _overloaded(self, e: EngineOverloaded):
         """429 + Retry-After: the structured load-shed answer. The router
@@ -284,6 +296,7 @@ class Handler(BaseHTTPRequestHandler):
                 render_engine_chips)
 
             body = (self.state.engine.metrics.registry.render()
+                    + tracing.metrics.registry.render()
                     + render_engine_chips()).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -414,7 +427,8 @@ class Handler(BaseHTTPRequestHandler):
     # -- POST ---------------------------------------------------------------
 
     def do_POST(self):
-        path = self.path.split("?")[0]
+        self._trace_ctx = None      # keep-alive: clear the previous
+        path = self.path.split("?")[0]          # request's trace identity
         body = self._read_body()
         if body is None:
             return
@@ -472,6 +486,70 @@ class Handler(BaseHTTPRequestHandler):
                          "queue_depth": len(eng.pending)})
 
     def _completions(self, body: dict, chat: bool):
+        """Span-lifecycle wrapper around the real handler: continues the
+        router's propagated ``traceparent`` into a ``server.request`` span,
+        then reconstructs the five phase children (admission, queue_wait,
+        prefill, decode, stream_out) retroactively from the engine Request's
+        monotonic timestamps once the response is written — the engine's hot
+        loop carries timestamps, never tracer calls."""
+        st = self.state
+        tracer = st.tracer
+        if tracer is None:
+            return self._completions_impl(body, chat)
+        t0_mono = time.monotonic()
+        parent = tracing.parse_traceparent(
+            self.headers.get(tracing.TRACEPARENT_HEADER))
+        span = tracer.start_span(
+            "server.request", parent=parent, kind=tracing.KIND_SERVER,
+            start_ns=tracing.mono_ns(t0_mono),
+            attributes={"http.route": ("/v1/chat/completions" if chat
+                                       else "/v1/completions"),
+                        "request.stream": bool(body.get("stream", False))})
+        raw_ddl = body.get(DEADLINE_FIELD, self.headers.get(DEADLINE_HEADER))
+        if raw_ddl is not None:
+            try:
+                span.set_attribute("deadline.remaining_ms",
+                                   int(float(raw_ddl)))
+            except (TypeError, ValueError):
+                pass
+        self._trace_ctx = span.context
+        self._trace_reqs = None
+        try:
+            return self._completions_impl(body, chat)
+        except Exception as e:
+            span.error(f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            self._emit_phase_spans(tracer, span, t0_mono)
+
+    def _emit_phase_spans(self, tracer, span, t0_mono: float):
+        """Phase children + request-span finish. Boundaries are the engine
+        Request's own transition timestamps, clamped to a monotonic chain
+        (an unset 0.0 collapses that phase to zero width at the previous
+        boundary — e.g. a non-streamed request ends stream_out ≈ t_done),
+        so consumers can rely on non-overlapping phases."""
+        end_mono = time.monotonic()
+        reqs = getattr(self, "_trace_reqs", None)
+        if reqs:
+            r = reqs[0]     # choice 0 == the n=1 request's timeline
+            bounds = [t0_mono, r.t_submit, r.t_prefill_start,
+                      r.t_first_token, r.t_done, end_mono]
+            for i in range(1, len(bounds)):
+                if bounds[i] <= 0.0 or bounds[i] < bounds[i - 1]:
+                    bounds[i] = bounds[i - 1]
+            names = ("admission", "queue_wait", "prefill", "decode",
+                     "stream_out")
+            for name, lo, hi in zip(names, bounds, bounds[1:]):
+                tracer.emit_span(name, span.context, tracing.mono_ns(lo),
+                                 tracing.mono_ns(hi),
+                                 attributes={"phase.ms":
+                                             round((hi - lo) * 1e3, 3)})
+            span.set_attribute("request.n_choices", len(reqs))
+            if r.finish_reason:
+                span.set_attribute("request.finish_reason", r.finish_reason)
+        tracer.finish(span, end_ns=tracing.mono_ns(end_mono))
+
+    def _completions_impl(self, body: dict, chat: bool):
         st = self.state
         model = body.get("model") or st.model_name
         lora_name = model if model in st.engine.lora_names else None
@@ -801,6 +879,9 @@ class Handler(BaseHTTPRequestHandler):
             return self._error(400, str(e))
 
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+        # hand the engine requests to the tracing wrapper: their monotonic
+        # timestamps become the phase spans after the response is written
+        self._trace_reqs = reqs
         if stream:
             self._stream_response(reqs, rid, chat, stops, model=model,
                                   n_prompt=len(prompt_ids),
@@ -924,6 +1005,11 @@ class Handler(BaseHTTPRequestHandler):
         usage = {"prompt_tokens": n_prompt,
                  "completion_tokens": completion_tokens,
                  "total_tokens": n_prompt + completion_tokens}
+        if self._trace_ctx is not None:
+            # log correlation without header plumbing: the ids a client
+            # pastes into Tempo to find this request's span tree
+            usage["trace_id"] = self._trace_ctx.trace_id
+            usage["span_id"] = self._trace_ctx.span_id
         self._json(200, {"id": rid,
                          "object": "chat.completion" if chat
                          else "text_completion",
@@ -961,12 +1047,16 @@ class Handler(BaseHTTPRequestHandler):
             body["usage"] = None
         raw_write(f"data: {json.dumps(body)}\n\n".encode())
         if include_usage:
+            usage = {"prompt_tokens": n_prompt,
+                     "completion_tokens": n_gen,
+                     "total_tokens": n_prompt + n_gen}
+            if self._trace_ctx is not None:
+                usage["trace_id"] = self._trace_ctx.trace_id
+                usage["span_id"] = self._trace_ctx.span_id
             raw_write(("data: " + json.dumps({
                 "id": rid, "object": obj, "created": _now(),
                 "model": model or self.state.model_name, "choices": [],
-                "usage": {"prompt_tokens": n_prompt,
-                          "completion_tokens": n_gen,
-                          "total_tokens": n_prompt + n_gen},
+                "usage": usage,
                 "failover": True}) + "\n\n").encode())
         raw_write(b"data: [DONE]\n\n")
         self.wfile.write(b"0\r\n\r\n")
@@ -1250,12 +1340,16 @@ class Handler(BaseHTTPRequestHandler):
                 # usage matches the undisturbed run; ``failover: true`` is
                 # the client-visible marker that this stream was failed over
                 n_gen = sum(len(s["req"].generated) for s in states)
+                usage = {"prompt_tokens": n_prompt,
+                         "completion_tokens": n_gen,
+                         "total_tokens": n_prompt + n_gen}
+                if self._trace_ctx is not None:
+                    usage["trace_id"] = self._trace_ctx.trace_id
+                    usage["span_id"] = self._trace_ctx.span_id
                 final = {
                     "id": rid, "object": obj, "created": _now(),
                     "model": model or st.model_name, "choices": [],
-                    "usage": {"prompt_tokens": n_prompt,
-                              "completion_tokens": n_gen,
-                              "total_tokens": n_prompt + n_gen},
+                    "usage": usage,
                 }
                 if is_resume:
                     final["failover"] = True
@@ -1391,7 +1485,15 @@ def build_state(serving_cfg=None, model_cfg=None, params=None,
                     draft=draft, lora=lora)
     templater = ChatTemplater(model_cfg.name, tokenizer,
                               template_path=serving.chat_template or None)
-    return ServerState(engine, tokenizer, templater, serving.model)
+    state = ServerState(engine, tokenizer, templater, serving.model)
+    # Tracing: config endpoint wins; empty falls back to the manifest's
+    # $OTEL_EXPORTER_OTLP_ENDPOINT; neither set = spans created (ids still
+    # echo into responses) but never exported.
+    state.tracer = tracing.build_tracer(
+        "tpu-serve-engine",
+        endpoint=getattr(serving, "otlp_endpoint", "") or None,
+        sample=getattr(serving, "trace_sample", 1.0))
+    return state
 
 
 def serve(state: ServerState, host: str, port: int,
@@ -1516,6 +1618,14 @@ def main(argv=None):
     p.add_argument("--admission-max-wait", type=float, default=0.0,
                    help="shed admissions whose estimated queue wait "
                         "(seconds) exceeds this (0 disables)")
+    p.add_argument("--otlp-endpoint", default="",
+                   help="OTLP/HTTP trace collector base URL (spans POST to "
+                        "<endpoint>/v1/traces); empty falls back to "
+                        "$OTEL_EXPORTER_OTLP_ENDPOINT, neither = tracing "
+                        "stays local (ids still echo in responses)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="root-span sampling probability in [0, 1]; "
+                        "propagated contexts keep the caller's decision")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
@@ -1566,6 +1676,8 @@ def main(argv=None):
         max_queue_depth=args.max_queue_depth,
         admission_max_wait_s=args.admission_max_wait,
         drain_timeout_s=args.drain_timeout,
+        otlp_endpoint=args.otlp_endpoint,
+        trace_sample=args.trace_sample,
         mesh=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep))
     state = build_state(serving)
     if not args.no_warmup:

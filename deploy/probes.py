@@ -247,11 +247,24 @@ def probe_l4(gv: Dict, inventory: Optional[str]) -> ProbeResult:
 
 
 def probe_l5(gv: Dict, inventory: Optional[str]) -> ProbeResult:
+    # Two conditions, both required: the collector pipeline is up AND the
+    # Tempo trace backend answers its readiness endpoint (:3200 /ready) —
+    # the serving path exports spans now (serving/tracing.py), so a dead
+    # Tempo is an L5 outage reconcile must notice, not a silent drop.
     override = os.environ.get("TPU_PROBE_COLLECTOR", "")
+    tempo_override = os.environ.get("TPU_PROBE_TEMPO", "")
     if override:
         status, body = _http_get(override)
-        return ProbeResult("L5", status == 200,
-                           f"collector {override} -> {status}")
+        if status != 200:
+            return ProbeResult("L5", False,
+                               f"collector {override} -> {status}")
+        if tempo_override:
+            t_status, _ = _http_get(tempo_override)
+            return ProbeResult(
+                "L5", t_status == 200,
+                f"collector {override} -> {status}, "
+                f"tempo {tempo_override} -> {t_status}")
+        return ProbeResult("L5", True, f"collector {override} -> {status}")
     vm = parse_inventory_vm(inventory)
     kubectl = "kubectl --kubeconfig /etc/kubernetes/admin.conf"
     ns = gv.get("otel_namespace", "otel-monitoring")
@@ -259,8 +272,24 @@ def probe_l5(gv: Dict, inventory: Optional[str]) -> ProbeResult:
         p = node_shell(vm, gv, f"{kubectl} -n {ns} get deploy --no-headers")
     except (OSError, subprocess.TimeoutExpired) as e:
         return ProbeResult("L5", False, f"kubectl unreachable: {e}")
-    return ProbeResult("L5", p.returncode == 0,
-                       f"otel namespace {ns} rc={p.returncode}")
+    if p.returncode != 0:
+        return ProbeResult("L5", False, f"otel namespace {ns} "
+                                        f"rc={p.returncode}")
+    # Tempo readiness from inside the cluster: its /ready on the
+    # tempo-query port (3200), hit via the Service DNS name so the probe
+    # exercises the same target the exporters POST to.
+    try:
+        t = node_shell(
+            vm, gv,
+            f"{kubectl} -n {ns} get deploy tempo -o "
+            "jsonpath='{.status.readyReplicas}'")
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return ProbeResult("L5", False, f"tempo check unreachable: {e}")
+    ready = (t.returncode == 0
+             and (t.stdout or "").strip().strip("'") not in ("", "0"))
+    return ProbeResult("L5", ready,
+                       f"otel namespace {ns} ok, tempo readyReplicas="
+                       f"{(t.stdout or '').strip() or '0'}")
 
 
 PROBES: Dict[str, Callable[[Dict, Optional[str]], ProbeResult]] = {

@@ -116,6 +116,19 @@ def _validate_workload(d: dict, name: str):
                         "readinessProbe but no lifecycle.preStop hook "
                         "(rolling restarts would cut its in-flight "
                         "requests; see serving.yaml.j2)")
+        # Tracing pairing: a container launched with an --otlp-endpoint-style
+        # flag must also export OTEL_EXPORTER_OTLP_ENDPOINT — the standard
+        # env is the documented fallback/override channel, and a flag
+        # without it means the template edit wired only half the contract.
+        argv = list(c.get("command") or []) + list(c.get("args") or [])
+        if any(isinstance(a, str) and a.startswith("--otlp-endpoint")
+               for a in argv):
+            env_names = {e.get("name") for e in c.get("env") or []}
+            if "OTEL_EXPORTER_OTLP_ENDPOINT" not in env_names:
+                _fail(name, f"{kind} {mname} container {c.get('name')} "
+                            "passes --otlp-endpoint but does not set the "
+                            "OTEL_EXPORTER_OTLP_ENDPOINT env var "
+                            "(serving/tracing.py's fallback contract)")
 
 
 def kubeconform_validate(text: str, name: str) -> bool:

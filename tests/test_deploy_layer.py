@@ -273,6 +273,72 @@ def test_otel_preserves_pipeline_shape():
     assert "exporters: [otlp/tempo, debug]" in text
 
 
+def test_serving_manifest_wires_otlp_endpoint_both_branches():
+    """Tracing satellite: engine AND router containers get --otlp-endpoint
+    plus the standard OTEL_EXPORTER_OTLP_ENDPOINT env (the validator's
+    pairing rule), in BOTH the production and rehearsal_cpu renders, and the
+    default endpoint targets the deployed Tempo's OTLP/HTTP receiver."""
+    from aws_k8s_ansible_provisioner_tpu.config import render_manifest
+
+    path = str(DEPLOY / "manifests" / "serving.yaml.j2")
+    renders = {
+        "production": render_manifest(path),
+        "rehearsal_cpu": render_manifest(path, rehearsal_cpu=True,
+                                         model="tiny-qwen3",
+                                         framework_image="img:rehearsal",
+                                         storage_class="standard"),
+    }
+    for branch, rendered in renders.items():
+        docs = {(d["kind"], d["metadata"]["name"]): d
+                for d in yaml.safe_load_all(rendered) if d}
+        for workload in ("tpu-serving-engine", "tpu-inference-gateway"):
+            c = docs[("Deployment", workload)]["spec"]["template"]["spec"][
+                "containers"][0]
+            argv = " ".join(c["command"])
+            assert "--otlp-endpoint" in argv, (branch, workload)
+            envs = {e["name"]: e.get("value", "") for e in c["env"]}
+            assert "OTEL_EXPORTER_OTLP_ENDPOINT" in envs, (branch, workload)
+            # default endpoint = the Tempo Service's own OTLP/HTTP port
+            assert envs["OTEL_EXPORTER_OTLP_ENDPOINT"] == \
+                "http://tempo.otel-monitoring.svc.cluster.local:4318", \
+                (branch, workload)
+
+
+def test_validator_requires_otlp_env_beside_flag():
+    """deploy/validate_manifests.py satellite: a container passing
+    --otlp-endpoint without OTEL_EXPORTER_OTLP_ENDPOINT fails validation."""
+    import sys
+
+    sys.path.insert(0, str(DEPLOY.parent))
+    from deploy.validate_manifests import ManifestError, structural_validate
+
+    bad = """\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: t
+spec:
+  selector:
+    matchLabels: {app: t}
+  template:
+    metadata:
+      labels: {app: t}
+    spec:
+      containers:
+        - name: c
+          image: img
+          command: ["python", "--otlp-endpoint", "http://x:4318"]
+"""
+    with pytest.raises(ManifestError, match="OTEL_EXPORTER_OTLP_ENDPOINT"):
+        structural_validate(bad, "bad")
+    good = bad + """\
+          env:
+            - name: OTEL_EXPORTER_OTLP_ENDPOINT
+              value: http://x:4318
+"""
+    assert structural_validate(good, "good") == 1
+
+
 def test_engine_service_is_headless():
     """Router does per-replica DNS load balancing — needs pod IPs, not a VIP."""
     docs = {(d["kind"], d["metadata"]["name"]): d for d in yaml.safe_load_all(

@@ -119,6 +119,30 @@ def test_probe_l5_override(monkeypatch):
     assert not probes.probe_l5({}, None).ok
 
 
+def test_probe_l5_tempo_readiness(monkeypatch):
+    """Tracing satellite: L5 additionally checks the Tempo trace backend.
+    TPU_PROBE_TEMPO mirrors TPU_PROBE_COLLECTOR for rehearsals — a healthy
+    collector with a dead Tempo must fail the probe (the serving path
+    exports spans now; a dark trace backend is an outage, not cosmetics)."""
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeReplica)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        _FakeReplica.draining = False
+        collector = f"http://127.0.0.1:{srv.server_port}/readyz"
+        monkeypatch.setenv("TPU_PROBE_COLLECTOR", collector)
+        # collector up, no tempo override: passes (back-compat)
+        assert probes.probe_l5({}, None).ok
+        # collector up, tempo dead: L5 fails and names tempo
+        monkeypatch.setenv("TPU_PROBE_TEMPO", "http://127.0.0.1:1/ready")
+        r = probes.probe_l5({}, None)
+        assert not r.ok and "tempo" in r.detail
+        # both up: passes
+        monkeypatch.setenv("TPU_PROBE_TEMPO", collector)
+        assert probes.probe_l5({}, None).ok
+    finally:
+        srv.shutdown()
+
+
 # -- the reconcile smoke script (orchestrator-level) -------------------------
 
 

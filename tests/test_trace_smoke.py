@@ -1,0 +1,290 @@
+"""Tracing smoke (`make trace-smoke`, marker ``trace_smoke``): the FULL
+export pipeline, hermetically — a real engine server behind the real router,
+both carrying real :class:`tracing.OTLPHTTPExporter` instances pointed at an
+in-process fake OTLP collector. Unlike tests/test_tracing.py (which records
+spans synchronously inside the tracer), every span here crosses the actual
+wire format: batched OTLP/JSON POSTs to ``/v1/traces``, one resourceSpans
+group per ``service.name``.
+
+Asserts the ISSUE's acceptance shape: a streamed and a unary completion each
+produce a single trace containing the router root span, the dispatch hop
+span(s), the server request span, and all five phase children with monotonic
+non-overlapping timestamps and propagated deadline attributes — and a KILLED
+exporter (chaos ``span_export``) changes no request outcome, only the
+``tpu_serve_spans_dropped_total`` counter.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+from aws_k8s_ansible_provisioner_tpu.serving import chaos, tracing
+from aws_k8s_ansible_provisioner_tpu.serving.router import (
+    BackendPool, RouterHandler, RouterMetrics)
+from aws_k8s_ansible_provisioner_tpu.serving.server import build_state, serve
+from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+pytestmark = pytest.mark.trace_smoke
+
+MODEL_NAME = "tiny-qwen3"
+ENGINE_PORT = 18252
+
+
+class FakeCollector(BaseHTTPRequestHandler):
+    """In-process OTLP/HTTP receiver: parses and stores every
+    ``POST /v1/traces`` payload (the only collector contract the exporter
+    relies on: 2xx = accepted)."""
+    received: list = []
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        payload = json.loads(self.rfile.read(n)) if n else {}
+        if self.path == "/v1/traces":
+            type(self).received.append(payload)
+        body = b"{}"
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _decode_attr(v: dict):
+    if "boolValue" in v:
+        return v["boolValue"]
+    if "intValue" in v:
+        return int(v["intValue"])
+    if "doubleValue" in v:
+        return v["doubleValue"]
+    return v.get("stringValue")
+
+
+def _flatten(payloads):
+    """Collector payloads → flat span dicts with decoded attributes."""
+    out = []
+    for p in payloads:
+        for rs in p.get("resourceSpans", []):
+            svc = ""
+            for a in rs.get("resource", {}).get("attributes", []):
+                if a["key"] == "service.name":
+                    svc = _decode_attr(a["value"])
+            for ss in rs.get("scopeSpans", []):
+                for s in ss.get("spans", []):
+                    out.append({
+                        "service": svc,
+                        "name": s["name"],
+                        "trace_id": s["traceId"],
+                        "span_id": s["spanId"],
+                        "parent": s.get("parentSpanId", ""),
+                        "kind": s.get("kind"),
+                        "start": int(s["startTimeUnixNano"]),
+                        "end": int(s["endTimeUnixNano"]),
+                        "attrs": {a["key"]: _decode_attr(a["value"])
+                                  for a in s.get("attributes", [])},
+                    })
+    return out
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """Fake collector + real engine + real router, the router and engine
+    each exporting through a real OTLPHTTPExporter (fast flush interval so
+    the tests don't wait out the production 1 s batching)."""
+    FakeCollector.received = []
+    collector = ThreadingHTTPServer(("127.0.0.1", 0), FakeCollector)
+    threading.Thread(target=collector.serve_forever, daemon=True).start()
+    endpoint = f"http://127.0.0.1:{collector.server_port}"
+
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    serving = ServingConfig(weights_dtype="bf16", model=MODEL_NAME,
+                            max_decode_slots=4, max_cache_len=128,
+                            prefill_buckets=(16, 32, 64), dtype="float32")
+    state = build_state(serving, model_cfg=cfg, params=params, tokenizer=tok)
+    exporters = [tracing.OTLPHTTPExporter(endpoint, flush_interval_s=0.05),
+                 tracing.OTLPHTTPExporter(endpoint, flush_interval_s=0.05)]
+    state.tracer = tracing.Tracer("tpu-serve-engine", exporter=exporters[0])
+    ready, stop = threading.Event(), threading.Event()
+    threading.Thread(target=serve,
+                     args=(state, "127.0.0.1", ENGINE_PORT, ready, stop),
+                     daemon=True).start()
+    assert ready.wait(30)
+
+    old = (RouterHandler.pool, RouterHandler.metrics, RouterHandler.tracer)
+    RouterHandler.pool = BackendPool(f"127.0.0.1:{ENGINE_PORT}",
+                                     cooldown_s=30.0)
+    RouterHandler.metrics = RouterMetrics()
+    RouterHandler.tracer = tracing.Tracer("tpu-serve-router",
+                                          exporter=exporters[1])
+    router = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    yield router, exporters
+    router.shutdown()
+    collector.shutdown()
+    stop.set()
+    for e in exporters:
+        e.shutdown()
+    (RouterHandler.pool, RouterHandler.metrics, RouterHandler.tracer) = old
+
+
+def _drain(exporters, trace_id, want: int, timeout_s: float = 10.0):
+    """Flush both exporters, then wait until the collector holds ``want``
+    spans of ``trace_id``; returns them parent-ordered-agnostically."""
+    for e in exporters:
+        assert e.flush(5.0)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        spans = [s for s in _flatten(FakeCollector.received)
+                 if s["trace_id"] == trace_id]
+        if len(spans) >= want:
+            return spans
+        time.sleep(0.02)
+    spans = [s for s in _flatten(FakeCollector.received)
+             if s["trace_id"] == trace_id]
+    raise AssertionError(f"collector has {len(spans)}/{want} spans of "
+                         f"{trace_id}: {[s['name'] for s in spans]}")
+
+
+PHASES = ["admission", "queue_wait", "prefill", "decode", "stream_out"]
+
+
+def _assert_tree(spans, *, streamed: bool):
+    """The acceptance-criterion span tree, from raw collector payloads."""
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    root = by_name["router.request"][0]
+    hops = sorted(by_name["router.dispatch"],
+                  key=lambda s: s["attrs"]["dispatch.index"])
+    server = by_name["server.request"][0]
+
+    assert root["service"] == "tpu-serve-router" and not root["parent"]
+    assert root["attrs"]["http.status_code"] == 200
+    assert hops and all(h["parent"] == root["span_id"] for h in hops)
+    assert hops[-1]["attrs"]["dispatch.outcome"] == \
+        ("stream_done" if streamed else "relayed")
+    # the server request hangs off the hop that dispatched it
+    assert server["service"] == "tpu-serve-engine"
+    assert server["parent"] == hops[-1]["span_id"]
+    assert server["attrs"]["request.stream"] is streamed
+    # the deadline attribute propagated: the hop stamped the remaining
+    # budget it forwarded, the server saw no more than that
+    hop_ddl = hops[-1]["attrs"]["deadline.remaining_ms"]
+    assert 0 < hop_ddl <= 30000
+    assert 0 < server["attrs"]["deadline.remaining_ms"] <= hop_ddl
+    # all five phases, children of the server span, monotonic and
+    # non-overlapping (shared boundaries, each inside the parent)
+    phases = [by_name[n][0] for n in PHASES]
+    for p in phases:
+        assert p["parent"] == server["span_id"]
+        assert p["service"] == "tpu-serve-engine"
+        assert server["start"] <= p["start"] <= p["end"] <= server["end"]
+    for prev, cur in zip(phases, phases[1:]):
+        assert prev["end"] == cur["start"]
+    assert phases[2]["end"] > phases[2]["start"]     # prefill has width
+    assert phases[3]["end"] > phases[3]["start"]     # decode has width
+    if streamed:
+        assert phases[4]["end"] > phases[4]["start"]  # stream_out has width
+
+
+def _rurl(router):
+    return f"http://127.0.0.1:{router.server_port}/v1/completions"
+
+
+def _post(router, payload, deadline_ms=30000):
+    req = urllib.request.Request(
+        _rurl(router), data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-Deadline-Ms": str(deadline_ms)})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        ctype = r.headers.get("Content-Type", "")
+        return r.status, ctype, r.read().decode()
+
+
+def test_streamed_request_full_span_tree(stack):
+    router, exporters = stack
+    status, ctype, raw = _post(router, {
+        "model": MODEL_NAME, "prompt": "trace me, streamed",
+        "max_tokens": 6, "seed": 11, "stream": True,
+        "stream_options": {"include_usage": True}})
+    assert status == 200 and ctype.startswith("text/event-stream")
+    events = [json.loads(ln[len("data: "):]) for ln in raw.splitlines()
+              if ln.startswith("data: ") and ln != "data: [DONE]"]
+    assert "data: [DONE]" in raw.splitlines()
+    usage = next(e["usage"] for e in reversed(events) if e.get("usage"))
+    trace_id = usage["trace_id"]        # echoed for log correlation
+    assert len(trace_id) == 32 and len(usage["span_id"]) == 16
+    spans = _drain(exporters, trace_id, want=8)   # root+hop+server+5 phases
+    _assert_tree(spans, streamed=True)
+
+
+def test_unary_request_full_span_tree(stack):
+    router, exporters = stack
+    status, _, raw = _post(router, {
+        "model": MODEL_NAME, "prompt": "trace me, unary",
+        "max_tokens": 6, "seed": 12})
+    assert status == 200
+    body = json.loads(raw)
+    trace_id = body["usage"]["trace_id"]
+    spans = _drain(exporters, trace_id, want=8)
+    _assert_tree(spans, streamed=False)
+    assert body["usage"]["span_id"] in {s["span_id"] for s in spans
+                                        if s["name"] == "server.request"}
+
+
+def test_killed_exporter_changes_no_request_outcome(stack):
+    """The acceptance criterion's kill test: with the collector refusing
+    every export (chaos ``span_export``), an identical seeded request
+    returns a byte-identical completion — the only difference tracing makes
+    is the dropped-spans counter."""
+    router, exporters = stack
+    payload = {"model": MODEL_NAME, "prompt": "collector outage",
+               "max_tokens": 6, "seed": 13}
+    status_ok, _, raw_ok = _post(router, payload)
+    ref = json.loads(raw_ok)
+    assert status_ok == 200
+    for e in exporters:                 # healthy baseline fully exported
+        assert e.flush(5.0)
+
+    chaos.reset()
+    chaos.get().inject("span_export", mode="refuse", times=-1)
+    d0 = tracing.metrics.spans_dropped.total()
+    try:
+        t0 = time.monotonic()
+        status, _, raw = _post(router, payload)
+        wall = time.monotonic() - t0
+        got = json.loads(raw)
+        assert status == 200
+        # identical outcome: same seeded tokens, same finish, same usage
+        # numbers (the trace ids differ by construction — fresh trace)
+        assert [c["text"] for c in got["choices"]] == \
+            [c["text"] for c in ref["choices"]]
+        assert [c["finish_reason"] for c in got["choices"]] == \
+            [c["finish_reason"] for c in ref["choices"]]
+        for k in ("prompt_tokens", "completion_tokens", "total_tokens"):
+            assert got["usage"][k] == ref["usage"][k]
+        # and the trace identity still echoes (spans exist, export drops)
+        assert len(got["usage"]["trace_id"]) == 32
+        # the outage converts to counted drops, never request latency/failure
+        for e in exporters:
+            assert e.flush(5.0)
+        assert tracing.metrics.spans_dropped.total() > d0
+        assert wall < 60.0
+        dead_trace = got["usage"]["trace_id"]
+        assert not [s for s in _flatten(FakeCollector.received)
+                    if s["trace_id"] == dead_trace]
+    finally:
+        chaos.reset()
