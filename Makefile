@@ -5,7 +5,8 @@
 PY ?= python
 
 .PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests \
-	overload-smoke resume-smoke reconcile-smoke trace-smoke
+	overload-smoke resume-smoke reconcile-smoke trace-smoke lint \
+	locksan-smoke
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -75,6 +76,39 @@ trace-smoke:
 # exact bytes it applies.
 validate-manifests:
 	$(PY) deploy/validate_manifests.py
+
+# Project-native static analysis (tools/tpulint, rules R1-R7: clock
+# discipline, metric registration/rendering, broad excepts, page-release,
+# lock discipline, chaos-fault test coverage, manifest-flag/CLI coherence)
+# + manifest validation + a NON-STRICT mypy pass over the typed serving/
+# deploy modules. mypy is a dev-extra (pip install -e .[dev]); the gate
+# skips it with a notice when not installed — tpulint itself is
+# dependency-free and always runs. Exit 0 == zero unsuppressed findings.
+# Tier-1 runs the same rules via tests/test_tpulint.py (marker `lint`).
+lint:
+	$(PY) -m tools.tpulint aws_k8s_ansible_provisioner_tpu deploy
+	$(PY) deploy/validate_manifests.py
+	@if $(PY) -c "import mypy" >/dev/null 2>&1; then \
+		$(PY) -m mypy --ignore-missing-imports --no-strict-optional \
+			--follow-imports=silent \
+			aws_k8s_ansible_provisioner_tpu/serving/tracing.py \
+			aws_k8s_ansible_provisioner_tpu/serving/metrics.py \
+			deploy/state.py; \
+	else \
+		echo "lint: mypy not installed (pip install -e .[dev]) — type check skipped"; \
+	fi
+
+# Deterministic lock/race sanitizer (serving/locksan.py) over the sanitizer
+# unit tests PLUS the thread-heaviest e2e subsets (drain, chaos, router e2e)
+# with TPU_LOCKSAN=1: every serving/ lock is order-tracked, a lock-order
+# cycle or cross-thread unguarded write fails the session (see the
+# _locksan_gate fixture), and seeded responses stay byte-identical with the
+# sanitizer on vs off. Tier-1 runs tests/test_locksan.py (marker
+# locksan_smoke) without the env; this target is the full instrumented run.
+locksan-smoke:
+	env JAX_PLATFORMS=cpu TPU_LOCKSAN=1 $(PY) -m pytest \
+		tests/test_locksan.py tests/test_drain.py tests/test_chaos.py \
+		tests/test_router_e2e.py -q -p no:cacheprovider
 
 # Full bench field-plumbing proof on CPU (tiny model, ~15 s): one JSON line
 # with every real-run field (bblock, weights_dtype, dma_steps_per_substep,

@@ -719,6 +719,21 @@ def spec_decode_step(cfg: ModelConfig, R: int, params, cache, tokens,
 class Engine:
     """Continuous-batching engine over a fixed set of decode slots."""
 
+    # Single-writer contract (tpulint R5 / LockSan): these attributes are
+    # mutated ONLY by the engine-step thread (run_forever -> step and its
+    # helpers). Other threads may read them (GIL-atomic snapshots for
+    # /health, /load and metrics) but never write. Attributes shared for
+    # WRITING across threads (draining, _drain_deadline, _stall_abort,
+    # _queued, ...) are NOT listed here — their writes go under self._lock.
+    _R5_THREAD_OWNED = (
+        "table", "lengths", "cache", "counts", "last_token",
+        "slot_req", "temps", "pres_pens", "freq_pens", "rep_pens",
+        "ban_until", "bias_ids", "bias_vals", "lora_idx", "_bias_n",
+        "_slot_pages", "_slot_tokens", "_chunk",
+        "_chunk_yield", "_prefill_streak", "_admission_blocked_since",
+        "_tok_times", "_admit_seq", "_seq_counter", "prompt_mask",
+    )
+
     def __init__(self, cfg: ModelConfig, params, serving: ServingConfig,
                  eos_token_id: Optional[int] = None, mesh=None, draft=None,
                  lora=None):
@@ -980,7 +995,8 @@ class Engine:
                 (self.num_slots, self.pages_per_slot)).copy()
             self._slot_pages: List[List[int]] = [[] for _ in
                                                  range(self.num_slots)]
-            # req id -> prompt+generated context for preemption resume
+            # req id -> prompt+generated context for preemption resume.
+            # tpulint: disable=R5 per-key happens-before — submit() installs a key BEFORE sched.submit publishes the id, the step thread touches it only after; dict ops are GIL-atomic
             self._resume_ctx: dict = {}
             # admission recency per slot: preemption victims are newest-first
             self._admit_seq = np.zeros(self.num_slots, np.int64)
@@ -1768,21 +1784,26 @@ class Engine:
         while draining keeps the FIRST deadline (preStop + SIGTERM both
         trigger it). Returns seconds until the drain deadline."""
         now = time.monotonic()
-        if self.draining:
-            return max(0.0, self._drain_deadline - now)
-        t = float(self.serving.drain_timeout_s
-                  if timeout_s is None else timeout_s)
-        t = max(0.0, t)
-        self.draining = True
-        self._drain_deadline = now + t
+        # begin_drain races preStop vs SIGTERM (two server threads): the
+        # check-then-set below must be atomic or the second caller could
+        # replace the first deadline.
+        with self._lock:
+            if self.draining:
+                return max(0.0, self._drain_deadline - now)
+            t = float(self.serving.drain_timeout_s
+                      if timeout_s is None else timeout_s)
+            t = max(0.0, t)
+            self.draining = True
+            self._drain_deadline = now + t
         self.metrics.draining.set(1)
         self._work_event.set()
         return t
 
     def end_drain(self):
         """Cancel a drain: admissions resume (operator abort / rollback)."""
-        self.draining = False
-        self._drain_deadline = 0.0
+        with self._lock:
+            self.draining = False
+            self._drain_deadline = 0.0
         self.metrics.draining.set(0)
         self._work_event.set()
 
@@ -2779,13 +2800,15 @@ class Engine:
             self.last_step_start = time.monotonic()
             try:
                 did_work = self.step()
+            # tpulint: disable=R3 fail-loud catch-all — _fail_all fails every in-flight request with its sentinel, /health records the error, loop keeps serving
             except Exception as e:
                 log.exception("engine step failed; failing in-flight requests")
                 self.last_error = f"{type(e).__name__}: {e}"
                 self._fail_all(self.last_error)
                 did_work = False
             self.last_step_start = 0.0
-            self._stall_abort = False   # the aborted step has unwound
+            with self._lock:
+                self._stall_abort = False   # the aborted step has unwound
             if not did_work:
                 self._work_event.wait(timeout=0.05)
                 self._work_event.clear()
@@ -2798,9 +2821,12 @@ class Engine:
         device call never sees the flag; for that class /healthz stays 503
         "stalled" until the K8s liveness restart (the pre-r7 behavior)."""
         while not stop.is_set():
-            if self.stalled_for_s > 0 and not self._stall_abort:
-                self._stall_abort = True
-                self.metrics.watchdog_stalls.inc()
+            if self.stalled_for_s > 0:
+                with self._lock:
+                    armed = not self._stall_abort
+                    self._stall_abort = True
+                if armed:
+                    self.metrics.watchdog_stalls.inc()
             stop.wait(min(1.0, max(0.05, self.STALL_AFTER_S / 4)))
 
     last_error: str = ""

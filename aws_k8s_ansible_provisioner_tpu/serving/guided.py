@@ -765,6 +765,7 @@ def token_byte_table(tokenizer) -> List[Optional[bytes]]:
 
     try:
         toks = inner.convert_ids_to_tokens(list(range(V)))
+    # tpulint: disable=R3 capability probe — tokenizers lacking convert_ids_to_tokens fall back to the decode-based byte table below
     except Exception:
         toks = None
     if toks is not None:

@@ -402,6 +402,7 @@ def start_load_poller(pool: BackendPool, interval_s: float = 1.0,
                                  "rotation", addr)
                     pool.note_load(addr, d.get("active", 0) or 0,
                                    d.get("queued", 0) or 0)
+        # tpulint: disable=R3 poller survival — a malformed /load reply must degrade to the stale-TTL path, never kill the poller thread
         except Exception:
             # NEVER let a malformed reply kill the poller thread — the
             # router would silently degrade to round-robin for its whole

@@ -42,7 +42,10 @@ DEADLINE_FIELD = "deadline_ms"
 
 
 def _now() -> int:
-    return int(time.time())
+    # API `created` fields are true wall-clock stamps — the one sanctioned
+    # wall-clock path (tpulint R1); everything deadline-shaped in this file
+    # is time.monotonic().
+    return int(tracing.wall_clock())
 
 
 class _NotifyQueue(queue.Queue):
@@ -416,6 +419,7 @@ class Handler(BaseHTTPRequestHandler):
             finally:
                 try:
                     _jax.profiler.stop_trace()
+                # tpulint: disable=R3 admin endpoint — a failed profiler stop is reported to the caller as a 500, not propagated into the handler thread
                 except Exception as e:
                     self._error(500, f"profiler stop failed: {e}",
                                 "internal_error")
@@ -452,10 +456,12 @@ class Handler(BaseHTTPRequestHandler):
                 self._error(404, f"no route for POST {path}")
         except BrokenPipeError:
             pass
-        except Exception as e:  # surface engine errors as 500s, don't kill thread
+        # tpulint: disable=R3 request boundary — engine errors surface as 500s; the handler thread must outlive any single request
+        except Exception as e:
             log.exception("request failed")
             try:
                 self._error(500, f"{type(e).__name__}: {e}", "internal_error")
+            # tpulint: disable=R3 best-effort error write — the client may already have hung up; nothing left to report to
             except Exception:
                 pass
         finally:
@@ -1654,6 +1660,7 @@ def main(argv=None):
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # tpulint: disable=R3 startup nicety — a missing compile cache slows warmup but must never block serving; warning carries the traceback
     except Exception:
         log.warning("persistent compile cache unavailable", exc_info=True)
 
@@ -1683,9 +1690,9 @@ def main(argv=None):
     if not args.no_warmup:
         log.info("warmup: compiling %d prefill buckets + decode ...",
                  len(state.engine.buckets))
-        t0 = time.time()
+        t0 = time.monotonic()
         state.engine.warmup()
-        log.info("warmup done in %.1fs", time.time() - t0)
+        log.info("warmup done in %.1fs", time.monotonic() - t0)
     # Graceful termination (r8): SIGTERM (k8s pod deletion, after the
     # preStop hook's explicit /admin/drain) flips the engine to draining —
     # new requests shed 503, /readyz 503 so the Service stops routing here,

@@ -49,11 +49,28 @@ KIND_INTERNAL = 1
 KIND_SERVER = 2
 KIND_CLIENT = 3
 
+# The serving tree's ONLY sanctioned wall-clock reads (tpulint R1): every
+# other site must use time.monotonic()/mono_ns — deadline or duration math
+# on the wall clock breaks the moment NTP steps it. True wall-clock stamps
+# (API ``created`` fields, span timestamps, log lines) route through these
+# two helpers so the intent is explicit and greppable.
+
+
+def wall_clock() -> float:
+    """Current unix time in seconds — the explicit wall-clock stamp."""
+    return time.time()
+
+
+def wall_clock_ns() -> int:
+    """Current unix time in nanoseconds — the explicit wall-clock stamp."""
+    return time.time_ns()
+
+
 # One (monotonic, wall) reference pair per process: every span derived from
 # engine monotonic timestamps shares the same skew, so phase children never
 # jitter against each other even if the wall clock steps mid-request.
 _MONO_REF = time.monotonic()
-_WALL_REF_NS = time.time_ns()
+_WALL_REF_NS = wall_clock_ns()
 
 
 def mono_ns(t_mono: float) -> int:
@@ -153,7 +170,7 @@ class Span:
         self.context = context
         self.parent_span_id = parent_span_id
         self.kind = kind
-        self.start_ns = time.time_ns() if start_ns is None else int(start_ns)
+        self.start_ns = wall_clock_ns() if start_ns is None else int(start_ns)
         self.end_ns: Optional[int] = None
         self.attributes: Dict[str, object] = dict(attributes or {})
         self.status = "unset"       # "unset" | "ok" | "error"
@@ -228,7 +245,7 @@ class Tracer:
         drop). Unsampled spans are created-but-never-exported: their ids
         still flow into responses for log correlation."""
         if span.end_ns is None:
-            span.end_ns = time.time_ns() if end_ns is None else int(end_ns)
+            span.end_ns = wall_clock_ns() if end_ns is None else int(end_ns)
         if span.end_ns < span.start_ns:
             span.end_ns = span.start_ns
         if self.exporter is not None and span.context.sampled:
@@ -367,6 +384,7 @@ class OTLPHTTPExporter:
             try:
                 self._send(batch)
                 metrics.spans_exported.inc(len(batch))
+            # tpulint: disable=R3 drop-by-design — a dead collector costs telemetry, never requests; failures are counted below
             except Exception:
                 # Drop, count, carry on: a dead collector costs telemetry,
                 # never requests. (Includes the chaos-injected refuse/hang/

@@ -534,6 +534,7 @@ class Runner:
         short = module.rsplit(".", 1)[-1]
         try:
             tname = self.templar.render(task.get("name", short), ctx)
+        # tpulint: disable=R3 cosmetic render — an unrenderable task *name* falls back to the raw string; the task itself still runs and fails loudly
         except Exception:
             tname = task.get("name", short)
 

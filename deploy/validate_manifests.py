@@ -173,6 +173,34 @@ def _render_all() -> list:
     return out
 
 
+def r7_flag_check() -> int:
+    """Template-flag/CLI cross-check, shared with tpulint rule R7: every
+    ``--flag`` in a flow-style ``command: [...]`` of deploy/manifests/*.j2
+    must be accepted by the ``python -m <module>`` CLI it targets. Runs on
+    the TEMPLATE (pre-render) so it also covers variants no render profile
+    exercises. Best-effort: silently skipped when tools/tpulint is absent
+    (a standalone copy of deploy/). Returns the number of findings."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    try:
+        from tools.tpulint.core import Project
+        from tools.tpulint.rules import r7_check_template
+    except ImportError:
+        return 0
+    project = Project(REPO, ("aws_k8s_ansible_provisioner_tpu", "deploy"))
+    mdir = os.path.join(REPO, "deploy", "manifests")
+    findings = []
+    for fn in sorted(os.listdir(mdir)):
+        if fn.endswith(".j2"):
+            rel = f"deploy/manifests/{fn}"
+            with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+                findings.extend(r7_check_template(project, rel, fh.read()))
+    for f in findings:
+        print(f"MANIFEST INVALID: {f.path}:{f.line}: {f.message}",
+              file=sys.stderr)
+    return len(findings)
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv:
@@ -188,10 +216,12 @@ def main(argv=None) -> int:
     except ManifestError as e:
         print(f"MANIFEST INVALID: {e}", file=sys.stderr)
         return 1
+    if r7_flag_check():
+        return 1
     mode = "kubeconform + structural" if used_kubeconform else \
         "structural (kubeconform not on PATH)"
     print(f"manifests valid: {len(targets)} render(s), {n_docs} documents "
-          f"[{mode}]")
+          f"[{mode}; flag/CLI cross-check via tpulint R7]")
     return 0
 
 

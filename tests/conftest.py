@@ -25,6 +25,30 @@ import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# LockSan (serving/locksan.py): TPU_LOCKSAN=1 runs the whole session under
+# the deterministic lock-order sanitizer. Install must precede the serving
+# imports inside test modules so every serving/ lock construction is seen —
+# conftest import time is before any collection, which guarantees that.
+_LOCKSAN = os.environ.get("TPU_LOCKSAN") == "1"
+if _LOCKSAN:
+    from aws_k8s_ansible_provisioner_tpu.serving import locksan
+
+    locksan.install()
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _locksan_gate():
+    """Fail the session if the sanitizer recorded any violation. Tests that
+    provoke violations on purpose (tests/test_locksan.py) reset() before
+    returning, so anything left here leaked from real serving code."""
+    yield
+    if _LOCKSAN:
+        from aws_k8s_ansible_provisioner_tpu.serving import locksan
+
+        vs = locksan.violations()
+        assert not vs, "LockSan violations leaked from the run:\n" + \
+            locksan.report()
+
 # NOTE: do NOT enable jax's persistent compilation cache here — serializing
 # INTERPRET-mode Pallas executables (the CPU test path for every kernel)
 # segfaults in put_executable_and_time (observed: full-suite crash in

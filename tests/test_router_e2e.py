@@ -286,6 +286,43 @@ def test_replica_kill_mid_stream_failover_is_byte_identical():
         teardown()
 
 
+def test_injected_stream_read_error_fails_over():
+    """stream_read_error chaos (the ROUTER-side fault point): an injected
+    ConnectionResetError on the SSE relay's backend read — no server
+    cooperation at all — must drive the same mid-stream failover path as a
+    real replica death: the client stream completes with token ids and text
+    byte-identical to an undisturbed seeded run, one
+    tpu_router_stream_failovers_total, and clean slot accounting."""
+    import time
+
+    from aws_k8s_ansible_provisioner_tpu.serving import chaos
+
+    router, engines, teardown = _fresh_stack((18260, 18261))
+    rurl = f"http://127.0.0.1:{router.server_port}"
+    payload = {"model": MODEL_NAME, "prompt": "read error scenario",
+               "max_tokens": 16, "stream": True, "seed": 4242,
+               "temperature": 0.7, "ignore_eos": True}
+    try:
+        ref = _collect_stream(rurl, payload)   # undisturbed seeded reference
+        assert len(ref[0]) == 16 and ref[3], ref
+
+        chaos.reset()
+        chaos.get().inject("stream_read_error", times=1, after_events=3)
+        got = _collect_stream(rurl, payload)
+        assert chaos.get().stats()["stream_read_error"]["fired"] == 1
+        assert got[0] == ref[0], "token ids diverged across the failover"
+        assert got[1] == ref[1], "text diverged across the failover"
+        assert got[3], "stream missing [DONE]"
+        assert RouterHandler.metrics.stream_failovers.total() == 1
+        time.sleep(0.3)
+        for state, _ in engines:
+            st = state.engine.sched.stats()
+            assert st.active_slots == 0 and st.queue_depth == 0, st
+    finally:
+        chaos.reset()
+        teardown()
+
+
 def test_drained_replica_leaves_and_reenters_rotation():
     """POST /admin/drain (exit:false) removes a replica from the router's
     rotation within one poll interval WITHOUT dead-marking it; new requests

@@ -1,0 +1,54 @@
+"""tpulint: project-native static analysis for the serving + deploy stack.
+
+The serving stack is a five-thread concurrent system (engine step loop,
+watchdog, drain watcher, OTLP exporter thread, router load-poller) whose
+correctness contracts were, until this tool, enforced only by convention:
+monotonic-clock-only deadline math, exactly-once slot/page release, every
+``tpu_serve_*`` counter actually rendered on a ``/metrics`` route, every
+chaos fault point exercised by a test, every manifest-templated flag
+accepted by its target CLI. Convention-held invariants are the ones that
+break first at scale; tpulint makes them machine-checked (the same shape of
+correctness tooling vLLM-class serving stacks carry in CI).
+
+Usage::
+
+    python -m tools.tpulint aws_k8s_ansible_provisioner_tpu deploy
+
+Rules (see tools/tpulint/rules.py and the README "Static analysis" table):
+
+=====  ====================================================================
+R1     no wall-clock ``time.time()``/``time.time_ns()`` in ``serving/`` —
+       deadline/duration math must use ``time.monotonic()`` / ``mono_ns``;
+       true wall-clock stamps go through the ``wall_clock()`` /
+       ``wall_clock_ns()`` helpers (serving/tracing.py), which R1 allowlists
+R2     every ``tpu_serve_*`` metric must be registered into a rendered
+       registry; shared (module-level singleton) metric sets must be
+       rendered by BOTH the engine's and the router's ``/metrics`` routes;
+       ``*.metrics.<attr>.inc/set/add/observe`` must resolve to a
+       registered metric attribute (cross-file check)
+R3     no broad ``except Exception``/``except BaseException``/bare
+       ``except`` in ``serving/`` + ``deploy/`` without a re-raise,
+       classified handling (``classify_failure``), or a reasoned pragma
+R4     every page/slot acquire (``PagePool.alloc``, scheduler admissions
+       via ``pop_admission``) must release on all exit edges: a
+       ``try/finally`` releasing, the tracked ``_slot_pages`` registry, or
+       a release helper in the same function
+R5     in classes that spawn threads, attributes written from 2+ methods
+       must be written under ``with self._lock`` (or be a thread-safe type,
+       be declared in ``_R5_THREAD_OWNED``, or carry a reasoned pragma);
+       LockSan (serving/locksan.py) is the runtime complement
+R6     every fault point in ``serving/chaos.py``'s ``FAULTS`` tuple must be
+       referenced by at least one test under ``tests/``
+R7     every ``--flag`` templated into a container command in
+       ``deploy/manifests/serving.yaml.j2`` must be accepted by that
+       command's argparse CLI (extends deploy/validate_manifests.py)
+=====  ====================================================================
+
+Suppression: ``# tpulint: disable=R3 <reason>`` on the flagged line or the
+line above. The reason is mandatory — a bare pragma is itself reported.
+"""
+
+from tools.tpulint.core import (Finding, LintError, Project,  # noqa: F401
+                                run_lint)
+
+__all__ = ["Finding", "LintError", "Project", "run_lint"]

@@ -1,0 +1,66 @@
+"""CLI driver: ``python -m tools.tpulint [roots...]``.
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 internal tool error — a
+crashing linter must never be mistaken for a clean tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.tpulint.core import LintError, run_lint, rules
+
+DEFAULT_ROOTS = ("aws_k8s_ansible_provisioner_tpu", "deploy")
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools.tpulint",
+        description="project-native static analysis (rules R1-R7)")
+    p.add_argument("roots", nargs="*", default=list(DEFAULT_ROOTS),
+                   help="directories/files to lint, relative to --root "
+                        f"(default: {' '.join(DEFAULT_ROOTS)})")
+    p.add_argument("--root", default=REPO_ROOT,
+                   help="repository root (default: autodetected from this "
+                        "file's location)")
+    p.add_argument("--rule", action="append", default=[], metavar="RID",
+                   help="run only this rule (repeatable); also skips the "
+                        "pragma-reason check")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rid, (title, _fn) in sorted(rules().items()):
+            print(f"{rid}  {title}")
+        return 0
+
+    try:
+        findings = run_lint(args.root, args.roots,
+                            only=args.rule or None)
+    except LintError as e:
+        print(f"tpulint: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps([{"rule": f.rule, "path": f.path, "line": f.line,
+                           "message": f.message} for f in findings],
+                         indent=2))
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}: {f.rule}: {f.message}")
+        n = len(findings)
+        print(f"tpulint: {n} finding{'s' if n != 1 else ''}"
+              if n else "tpulint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

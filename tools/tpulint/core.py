@@ -1,0 +1,287 @@
+"""tpulint core: source model, pragma handling, rule registry, runner.
+
+Design notes
+------------
+
+- **One parse per file.** Every rule sees the same :class:`SourceFile`
+  (text + ast + pragma map); cross-file rules get the whole
+  :class:`Project`.
+- **Pragmas are findings too.** ``# tpulint: disable=R3`` without a reason
+  is reported (rule id ``PRAGMA``) — a suppression that doesn't say *why*
+  is exactly the convention-rot this tool exists to stop. Unused pragmas
+  are tolerated (rules evolve; stale pragmas are cleaned up by review).
+- **Determinism.** Findings sort by (path, line, rule, message); two runs
+  over the same tree emit byte-identical reports. No wall clock, no
+  randomness — the tool must be safe to diff in CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+PRAGMA_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Z0-9,]+)(?:\s+(.*))?$")
+
+
+class LintError(Exception):
+    """Internal tool failure (unparseable file, missing anchor) — distinct
+    from findings: the tool crashing must never read as 'tree is clean'."""
+
+
+class Finding:
+    __slots__ = ("rule", "path", "line", "message")
+
+    def __init__(self, rule: str, path: str, line: int, message: str):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.message = message
+
+    def key(self) -> Tuple:
+        return (self.path, self.line, self.rule, self.message)
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+class SourceFile:
+    """One parsed python file: text, lines, AST, pragma map."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            raise LintError(f"{rel}: unparseable: {e}") from e
+        # line -> (set of rule ids, reason) for every pragma comment
+        self.pragmas: Dict[int, Tuple[set, str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = PRAGMA_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                reason = (m.group(2) or "").strip()
+                self.pragmas[i] = (rules, reason)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        """A pragma on the flagged line, or on the line directly above,
+        with a non-empty reason, suppresses the finding."""
+        for ln in (line, line - 1):
+            entry = self.pragmas.get(ln)
+            if entry and rule in entry[0] and entry[1]:
+                return True
+        return False
+
+    @property
+    def in_serving(self) -> bool:
+        return "/serving/" in "/" + self.rel
+
+    @property
+    def in_deploy(self) -> bool:
+        return self.rel.startswith("deploy/")
+
+
+class Project:
+    """Everything the rules can see: parsed python files plus the non-python
+    artifacts the cross-file rules need (tests text, jinja manifests)."""
+
+    def __init__(self, repo_root: str, roots: Sequence[str]):
+        self.repo_root = os.path.abspath(repo_root)
+        self.files: List[SourceFile] = []
+        seen = set()
+        for root in roots:
+            abs_root = os.path.join(self.repo_root, root)
+            if os.path.isfile(abs_root) and abs_root.endswith(".py"):
+                self._add(abs_root, seen)
+                continue
+            for dirpath, dirnames, filenames in os.walk(abs_root):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git", "node_modules"))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        self._add(os.path.join(dirpath, fn), seen)
+        self.files.sort(key=lambda f: f.rel)
+        self._by_rel = {f.rel: f for f in self.files}
+
+    def _add(self, path: str, seen: set):
+        path = os.path.abspath(path)
+        if path in seen:
+            return
+        seen.add(path)
+        rel = os.path.relpath(path, self.repo_root)
+        with open(path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        self.files.append(SourceFile(path, rel, text))
+
+    # -- lookups used by the cross-file rules -------------------------------
+
+    def get(self, rel_suffix: str) -> Optional[SourceFile]:
+        """The unique file whose repo-relative path ends with the suffix."""
+        hits = [f for f in self.files if f.rel.endswith(rel_suffix)]
+        return hits[0] if len(hits) == 1 else None
+
+    def serving_files(self) -> List[SourceFile]:
+        return [f for f in self.files if f.in_serving]
+
+    def tests_text(self) -> str:
+        """Concatenated text of tests/*.py (R6 reference scan)."""
+        tests_dir = os.path.join(self.repo_root, "tests")
+        chunks = []
+        if os.path.isdir(tests_dir):
+            for fn in sorted(os.listdir(tests_dir)):
+                if fn.endswith(".py"):
+                    with open(os.path.join(tests_dir, fn),
+                              encoding="utf-8") as fh:
+                        chunks.append(fh.read())
+        return "\n".join(chunks)
+
+    def read_artifact(self, rel: str) -> Optional[str]:
+        path = os.path.join(self.repo_root, rel)
+        if not os.path.isfile(path):
+            return None
+        with open(path, encoding="utf-8") as fh:
+            return fh.read()
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+# ---------------------------------------------------------------------------
+
+RuleFn = Callable[[Project], List[Finding]]
+_RULES: Dict[str, Tuple[str, RuleFn]] = {}
+
+
+def rule(rule_id: str, title: str):
+    def deco(fn: RuleFn) -> RuleFn:
+        _RULES[rule_id] = (title, fn)
+        return fn
+    return deco
+
+
+def rules() -> Dict[str, Tuple[str, RuleFn]]:
+    # import for side effect: populates the registry
+    from tools.tpulint import rules as _rules_mod  # noqa: F401
+    return dict(_RULES)
+
+
+def _pragma_findings(project: Project) -> List[Finding]:
+    """Reason-less pragmas are findings (rule id PRAGMA, unsuppressable)."""
+    out = []
+    for f in project.files:
+        for line, (ids, reason) in sorted(f.pragmas.items()):
+            if not reason:
+                out.append(Finding(
+                    "PRAGMA", f.rel, line,
+                    f"pragma disable={','.join(sorted(ids))} without a "
+                    "reason — every suppression must say why"))
+    return out
+
+
+def run_lint(repo_root: str, roots: Sequence[str],
+             only: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run all (or ``only``) rules over ``roots``; sorted findings."""
+    project = Project(repo_root, roots)
+    all_rules = rules()
+    selected = sorted(only) if only else sorted(all_rules)
+    findings: List[Finding] = []
+    for rid in selected:
+        if rid not in all_rules:
+            raise LintError(f"unknown rule {rid!r}; known: "
+                            f"{', '.join(sorted(all_rules))}")
+        _, fn = all_rules[rid]
+        for finding in fn(project):
+            src = project._by_rel.get(finding.path)
+            if src is not None and src.suppressed(finding.line, finding.rule):
+                continue
+            findings.append(finding)
+    if not only:
+        findings.extend(_pragma_findings(project))
+    findings.sort(key=Finding.key)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers (used by several rules)
+# ---------------------------------------------------------------------------
+
+
+def attr_chain(node: ast.AST) -> List[str]:
+    """['self', 'state', 'engine', 'metrics'] for self.state.engine.metrics;
+    [] when the chain bottoms out in something that isn't a Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def enclosing_functions(tree: ast.AST):
+    """Yield (funcdef, [ancestor stack]) for every function in the tree."""
+    stack: List[ast.AST] = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, list(stack)
+            stack.append(child)
+            yield from walk(child)
+            stack.pop()
+
+    yield from walk(tree)
+
+
+def contains_call_named(node: ast.AST, names: set) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            if isinstance(fn, ast.Attribute) and fn.attr in names:
+                return True
+            if isinstance(fn, ast.Name) and fn.id in names:
+                return True
+    return False
+
+
+def lock_guarded(node: ast.AST, ancestors: List[ast.AST]) -> bool:
+    """True when the node sits lexically inside ``with <...lock...>:``.
+
+    A with-item guards when its expression's attribute chain mentions a
+    segment containing 'lock' or 'cond' (``self._lock``,
+    ``self.pool._lock``, ``cls._registry_lock`` ...).
+    """
+    for anc in ancestors:
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                chain = attr_chain(item.context_expr)
+                if any(("lock" in seg.lower() or "cond" in seg.lower())
+                       for seg in chain):
+                    return True
+    return False
+
+
+def node_ancestors(tree: ast.AST, target: ast.AST) -> List[ast.AST]:
+    """Ancestor chain (outermost first) of ``target`` within ``tree``."""
+    result: List[ast.AST] = []
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if child is target:
+                result.extend(stack)
+                return True
+            stack.append(child)
+            if walk(child, stack):
+                return True
+            stack.pop()
+        return False
+
+    walk(tree, [])
+    return result

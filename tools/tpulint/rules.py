@@ -1,0 +1,703 @@
+"""tpulint rules R1-R7. Each rule is a pure function Project -> [Finding].
+
+These are PROJECT-NATIVE rules: they encode this repo's concurrency and
+observability contracts, not generic style. Where a rule is necessarily
+heuristic (R4's release-on-all-edges, R5's shared-attribute analysis) the
+docstring states the exact approximation so a finding — or its absence —
+is never mysterious. The runtime complement for R5 is
+serving/locksan.py (lock-order cycles + unguarded-access sampling).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.tpulint.core import (Finding, Project, SourceFile, attr_chain,
+                                rule)
+
+# ---------------------------------------------------------------------------
+# shared walking helpers
+# ---------------------------------------------------------------------------
+
+
+def _walk_with_stack(root: ast.AST):
+    """Yield (node, ancestors) for every descendant, outermost-first stack."""
+    stack: List[ast.AST] = [root]
+
+    def rec(node):
+        for child in ast.iter_child_nodes(node):
+            yield child, list(stack)
+            stack.append(child)
+            yield from rec(child)
+            stack.pop()
+
+    yield from rec(root)
+
+
+def _enclosing_funcdef(ancestors: List[ast.AST]):
+    for anc in reversed(ancestors):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def _with_lock(ancestors: List[ast.AST]) -> bool:
+    for anc in ancestors:
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                chain = attr_chain(item.context_expr)
+                if any("lock" in seg.lower() or "cond" in seg.lower()
+                       for seg in chain):
+                    return True
+    return False
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# R1: monotonic-clock discipline in serving/
+# ---------------------------------------------------------------------------
+
+
+_R1_ALLOWED_DEFS = {"wall_clock", "wall_clock_ns"}
+
+
+@rule("R1", "no wall-clock time.time()/time_ns() in serving/")
+def r1_wall_clock(project: Project) -> List[Finding]:
+    """Deadline and duration math in serving/ must use ``time.monotonic()``
+    (or the tracing ``mono_ns`` mapping); a wall-clock read there breaks
+    deadline accounting the moment NTP steps the clock. True wall-clock
+    stamps (API ``created`` fields, log timestamps) go through the explicit
+    ``wall_clock()`` / ``wall_clock_ns()`` helpers, whose definitions are
+    the only sites this rule allowlists."""
+    out: List[Finding] = []
+    for f in project.serving_files():
+        for node, ancestors in _walk_with_stack(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr in ("time", "time_ns")
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "time"):
+                continue
+            encl = _enclosing_funcdef(ancestors)
+            if encl is not None and encl.name in _R1_ALLOWED_DEFS:
+                continue
+            out.append(Finding(
+                "R1", f.rel, node.lineno,
+                f"wall-clock time.{fn.attr}() in serving/ — use "
+                "time.monotonic()/mono_ns for deadline or duration math, or "
+                "wall_clock()/wall_clock_ns() (serving/tracing.py) for a "
+                "true wall-clock stamp"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R2: every tpu_serve_* metric registered AND rendered
+# ---------------------------------------------------------------------------
+
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+_METRIC_OPS = {"inc", "set", "add", "observe"}
+
+
+class _MetricClass:
+    def __init__(self, name: str, file: SourceFile, lineno: int):
+        self.name = name
+        self.file = file
+        self.lineno = lineno
+        self.attrs: Dict[str, str] = {}     # attr -> metric name
+        self.shared = False                  # module-level singleton
+
+
+def _collect_metric_classes(project: Project) -> Dict[str, _MetricClass]:
+    classes: Dict[str, _MetricClass] = {}
+    for f in project.serving_files():
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            mc = _MetricClass(node.name, f, node.lineno)
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                        and isinstance(sub.value, ast.Call)):
+                    continue
+                call = sub.value
+                if not (isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "register" and call.args):
+                    continue
+                inner = call.args[0]
+                if not (isinstance(inner, ast.Call)
+                        and isinstance(inner.func, ast.Name)
+                        and inner.func.id in _METRIC_CTORS and inner.args):
+                    continue
+                mname = _const_str(inner.args[0])
+                tgt = sub.targets[0]
+                if mname and isinstance(tgt, ast.Attribute):
+                    mc.attrs[tgt.attr] = mname
+            if mc.attrs:
+                classes[mc.name] = mc
+        # module-level singletons: `metrics = TraceMetrics()` at top level
+        for stmt in f.tree.body:
+            if (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)
+                    and isinstance(stmt.value.func, ast.Name)
+                    and stmt.value.func.id in classes
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                classes[stmt.value.func.id].shared = True
+    return classes
+
+
+def _render_owners(f: SourceFile) -> Set[Tuple[str, ...]]:
+    """Attribute chains whose ``.registry.render()`` runs inside the file's
+    ``/metrics`` route branch (an If whose test mentions "/metrics")."""
+    owners: Set[Tuple[str, ...]] = set()
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.If):
+            continue
+        if not any(_const_str(t) == "/metrics" for t in ast.walk(node.test)):
+            continue
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "render"):
+                    chain = attr_chain(sub.func.value)
+                    if chain and chain[-1] == "registry":
+                        owners.add(tuple(chain[:-1]))
+    return owners
+
+
+def _resolve_owner(chain: Tuple[str, ...], route_file: SourceFile,
+                   project: Project,
+                   classes: Dict[str, _MetricClass]) -> Optional[str]:
+    """Map a rendered chain like ('self','state','engine','metrics') /
+    ('tracing','metrics') / ('self','metrics') to a metric class name."""
+    # module-alias singleton: <module>.metrics where <module> defines
+    # `metrics = SomeMetricClass()` at top level
+    if len(chain) >= 2:
+        mod_seg, var = chain[-2], chain[-1]
+        mod_file = project.get(f"serving/{mod_seg}.py")
+        if mod_file is not None:
+            for stmt in mod_file.tree.body:
+                if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id == var
+                        and isinstance(stmt.value, ast.Call)
+                        and isinstance(stmt.value.func, ast.Name)
+                        and stmt.value.func.id in classes):
+                    return stmt.value.func.id
+    # engine-owned: any chain segment 'engine' -> the class engine.py binds
+    # to self.metrics
+    if "engine" in chain:
+        eng = project.get("serving/engine.py")
+        if eng is not None:
+            for node in ast.walk(eng.tree):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Attribute)
+                        and node.targets[0].attr == "metrics"
+                        and isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Name)
+                        and node.value.func.id in classes):
+                    return node.value.func.id
+    # handler-local: `<X>.metrics` assigned a metric class in the route file
+    for node in ast.walk(route_file.tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Attribute)
+                and node.targets[0].attr == chain[-1]
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id in classes):
+            return node.value.func.id
+    return None
+
+
+@rule("R2", "tpu_serve_* metrics registered and rendered on /metrics")
+def r2_metrics(project: Project) -> List[Finding]:
+    """Three checks, all cross-file:
+
+    1. every ``Counter/Gauge/Histogram("tpu_serve_...")`` construction must
+       be wrapped in a ``registry.register(...)`` inside a metric-set class
+       (an unregistered metric renders nowhere — it silently lies);
+    2. every ``*.metrics.<attr>.inc/set/add/observe(...)`` must resolve to
+       an attribute some metric-set class registered (catching increments
+       of metrics that don't exist);
+    3. render coverage: a shared (module-level singleton) metric set with
+       ``tpu_serve_*`` names must be rendered by BOTH the engine server's
+       and the router's ``/metrics`` routes; a non-shared ``tpu_serve_*``
+       set by the engine server's; anything else by at least one.
+    """
+    out: List[Finding] = []
+    classes = _collect_metric_classes(project)
+    registered_attrs: Set[str] = set()
+    for mc in classes.values():
+        registered_attrs.update(mc.attrs)
+
+    # (1) naked tpu_serve_* constructions
+    for f in project.serving_files():
+        for node, ancestors in _walk_with_stack(f.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in _METRIC_CTORS and node.args):
+                continue
+            mname = _const_str(node.args[0])
+            if not mname or not mname.startswith("tpu_serve_"):
+                continue
+            parent = ancestors[-1] if ancestors else None
+            is_registered = (isinstance(parent, ast.Call)
+                             and isinstance(parent.func, ast.Attribute)
+                             and parent.func.attr == "register")
+            if not is_registered:
+                out.append(Finding(
+                    "R2", f.rel, node.lineno,
+                    f"metric {mname!r} constructed outside "
+                    "registry.register(...) — it will never render on a "
+                    "/metrics route"))
+
+    # (2) increments must resolve to registered attributes
+    for f in project.serving_files():
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_OPS):
+                continue
+            chain = attr_chain(node.func.value)
+            if len(chain) < 2 or chain[-2] != "metrics":
+                continue
+            attr = chain[-1]
+            if attr not in registered_attrs:
+                out.append(Finding(
+                    "R2", f.rel, node.lineno,
+                    f"increment of unregistered metric attribute "
+                    f"'{attr}' — no metric-set class registers it"))
+
+    # (3) render coverage
+    server = project.get("serving/server.py")
+    router = project.get("serving/router.py")
+    if server is None or router is None:
+        return out
+    server_owned = {_resolve_owner(c, server, project, classes)
+                    for c in _render_owners(server)}
+    router_owned = {_resolve_owner(c, router, project, classes)
+                    for c in _render_owners(router)}
+    for mc in sorted(classes.values(), key=lambda m: m.name):
+        has_serve = any(n.startswith("tpu_serve_")
+                        for n in mc.attrs.values())
+        if mc.shared and has_serve:
+            missing = [r for r, owned in (("server", server_owned),
+                                          ("router", router_owned))
+                       if mc.name not in owned]
+            if missing:
+                out.append(Finding(
+                    "R2", mc.file.rel, mc.lineno,
+                    f"shared metric set {mc.name} (tpu_serve_* names) is "
+                    f"not rendered by the {' and '.join(missing)} /metrics "
+                    "route(s) — both must render it"))
+        elif has_serve:
+            if mc.name not in server_owned:
+                out.append(Finding(
+                    "R2", mc.file.rel, mc.lineno,
+                    f"metric set {mc.name} registers tpu_serve_* metrics "
+                    "but the engine server's /metrics route never renders "
+                    "its registry"))
+        else:
+            if mc.name not in server_owned | router_owned:
+                out.append(Finding(
+                    "R2", mc.file.rel, mc.lineno,
+                    f"metric set {mc.name} is rendered by no /metrics "
+                    "route"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R3: no unclassified broad excepts in serving/ + deploy/
+# ---------------------------------------------------------------------------
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+@rule("R3", "broad excepts must re-raise, classify, or carry a pragma")
+def r3_broad_except(project: Project) -> List[Finding]:
+    """``except Exception`` in serving/ or deploy/ must re-raise, route
+    through the failure taxonomy (``classify_failure``), or carry a
+    reasoned ``# tpulint: disable=R3`` pragma. A broad handler that just
+    logs converts every future bug into silence."""
+    out: List[Finding] = []
+    for f in project.files:
+        if not (f.in_serving or f.in_deploy):
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _broad_handler(node):
+                continue
+            body_has_raise = any(isinstance(s, ast.Raise)
+                                 for s in ast.walk(node))
+            body_classifies = False
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    fn = sub.func
+                    name = (fn.id if isinstance(fn, ast.Name)
+                            else fn.attr if isinstance(fn, ast.Attribute)
+                            else "")
+                    if name == "classify_failure":
+                        body_classifies = True
+            if body_has_raise or body_classifies:
+                continue
+            out.append(Finding(
+                "R3", f.rel, node.lineno,
+                "broad except without re-raise or classified handling — "
+                "narrow it, classify via classify_failure, or suppress "
+                "with `# tpulint: disable=R3 <reason>`"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R4: page/slot acquires release on all exit edges
+# ---------------------------------------------------------------------------
+
+_R4_ACQUIRES = {"alloc", "pop_admission"}
+_R4_RELEASES = {"release", "release_all", "free", "_release_slot_pages",
+                "requeue"}
+_R4_TRACKED = "_slot_pages"
+
+
+@rule("R4", "slot/page acquires must release on all exit edges")
+def r4_release(project: Project) -> List[Finding]:
+    """Every ``<pool>.alloc(...)`` / ``<sched>.pop_admission()`` in
+    serving/ must have a release story in its enclosing function: either
+    the call sits in a ``try`` whose ``finally`` releases, or the function
+    hands pages to the tracked ``_slot_pages`` registry (released
+    exactly-once by ``_release_slot_pages``), or it calls a release helper
+    (``release``/``release_all``/``free``/``requeue``) on some edge. This
+    is an existence check, not a path proof — LockSan plus the chaos tests
+    cover the dynamic side — but it catches the classic regression: a new
+    early return between acquire and hand-off."""
+    out: List[Finding] = []
+    for f in project.serving_files():
+        for node, ancestors in _walk_with_stack(f.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _R4_ACQUIRES):
+                continue
+            encl = _enclosing_funcdef(ancestors)
+            if encl is None:
+                out.append(Finding(
+                    "R4", f.rel, node.lineno,
+                    f"module-level {node.func.attr}() with no enclosing "
+                    "function to own the release"))
+                continue
+            if encl.name in ("alloc", "pop_admission"):
+                continue        # the allocator's own definition/forwarder
+            ok = False
+            for anc in ancestors:
+                if isinstance(anc, ast.Try) and anc.finalbody:
+                    for s in anc.finalbody:
+                        for sub in ast.walk(s):
+                            if (isinstance(sub, ast.Call)
+                                    and isinstance(sub.func, ast.Attribute)
+                                    and sub.func.attr in _R4_RELEASES):
+                                ok = True
+            if not ok:
+                for sub in ast.walk(encl):
+                    if isinstance(sub, ast.Attribute) \
+                            and sub.attr == _R4_TRACKED:
+                        ok = True
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr in _R4_RELEASES):
+                        ok = True
+            if not ok:
+                out.append(Finding(
+                    "R4", f.rel, node.lineno,
+                    f"{node.func.attr}() acquires pages/slots but the "
+                    f"enclosing function '{encl.name}' neither releases "
+                    "(try/finally or release helper) nor hands them to the "
+                    "tracked _slot_pages registry"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R5: shared mutable attributes only touched under the lock
+# ---------------------------------------------------------------------------
+
+_SAFE_TYPES = {"Event", "Lock", "RLock", "Condition", "Queue", "SimpleQueue",
+               "LifoQueue", "PriorityQueue", "deque", "Semaphore",
+               "BoundedSemaphore", "local", "Barrier"}
+_MUT_CALLS = {"append", "extend", "add", "remove", "discard", "update",
+              "clear", "pop", "popitem", "popleft", "appendleft", "insert",
+              "setdefault"}
+_OWNED_DECL = "_R5_THREAD_OWNED"
+
+
+def _thread_target_methods(project: Project) -> Set[str]:
+    names: Set[str] = set()
+    for f in project.files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain or chain[-1] != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target" and isinstance(kw.value, ast.Attribute):
+                    names.add(kw.value.attr)
+    return names
+
+
+def _self_writes(method: ast.FunctionDef):
+    """Yield (attr, lineno, guarded) for writes to self.<attr> (stores,
+    augmented stores, subscript stores, mutating method calls)."""
+    for node, ancestors in _walk_with_stack(method):
+        guarded = _with_lock(ancestors)
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        flat: List[ast.AST] = []
+        for tgt in targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                flat.extend(tgt.elts)
+            else:
+                flat.append(tgt)
+        for t in flat:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                yield t.attr, t.lineno, guarded
+            elif (isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Attribute)
+                    and isinstance(t.value.value, ast.Name)
+                    and t.value.value.id == "self"):
+                yield t.value.attr, t.lineno, guarded
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUT_CALLS):
+            chain = attr_chain(node.func.value)
+            if len(chain) == 2 and chain[0] == "self":
+                yield chain[1], node.lineno, guarded
+
+
+@rule("R5", "thread-shared mutable attributes written only under the lock")
+def r5_shared_state(project: Project) -> List[Finding]:
+    """For every serving/ class that owns a thread entry point (a method
+    used as ``Thread(target=self.X)`` anywhere in the tree), an attribute
+    WRITTEN from two or more methods must take one of four postures:
+
+    - every write under ``with self.<lock>:`` (anything named *lock*/*cond*);
+    - a thread-safe type assigned in ``__init__`` (Event/Queue/deque/...);
+    - declared in the class's ``_R5_THREAD_OWNED`` tuple — the documented
+      single-writer-thread contract, verifiable at runtime by LockSan's
+      attribute guard;
+    - a reasoned ``# tpulint: disable=R5`` pragma on a write site or on the
+      attribute's ``__init__`` assignment.
+
+    Reads are deliberately exempt (benign racy reads of GIL-atomic values
+    are this stack's idiom; LockSan samples them dynamically instead).
+    """
+    out: List[Finding] = []
+    entry_names = _thread_target_methods(project)
+    for f in project.serving_files():
+        for cls in ast.walk(f.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = [n for n in cls.body
+                       if isinstance(n, ast.FunctionDef)]
+            if not any(m.name in entry_names for m in methods):
+                continue
+            owned: Set[str] = set()
+            safe: Set[str] = set()
+            init_lines: Dict[str, int] = {}
+            for stmt in cls.body:
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id == _OWNED_DECL):
+                    for elt in ast.walk(stmt.value):
+                        s = _const_str(elt)
+                        if s:
+                            owned.add(s)
+            for m in methods:
+                if m.name != "__init__":
+                    continue
+                for node in ast.walk(m):
+                    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                        t, val = node.targets[0], node.value
+                    elif isinstance(node, ast.AnnAssign):
+                        t, val = node.target, node.value
+                    else:
+                        continue
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    init_lines.setdefault(t.attr, t.lineno)
+                    if isinstance(val, ast.Call):
+                        chain = attr_chain(val.func)
+                        if chain and chain[-1] in _SAFE_TYPES:
+                            safe.add(t.attr)
+            # attr -> {method -> [(line, guarded)]}
+            writes: Dict[str, Dict[str, List[Tuple[int, bool]]]] = {}
+            for m in methods:
+                if m.name == "__init__":
+                    continue
+                for attr, line, guarded in _self_writes(m):
+                    writes.setdefault(attr, {}).setdefault(
+                        m.name, []).append((line, guarded))
+            for attr in sorted(writes):
+                if attr in safe or attr in owned or attr.endswith("lock"):
+                    continue
+                by_method = writes[attr]
+                if len(by_method) < 2:
+                    continue
+                unguarded = sorted(
+                    (line, meth) for meth, sites in by_method.items()
+                    for line, g in sites if not g)
+                if not unguarded:
+                    continue
+                site_lines = [ln for sites in by_method.values()
+                              for ln, _ in sites]
+                if attr in init_lines:
+                    site_lines.append(init_lines[attr])
+                if any(f.suppressed(ln, "R5") for ln in site_lines):
+                    continue
+                meths = ", ".join(sorted(by_method))
+                out.append(Finding(
+                    "R5", f.rel, unguarded[0][0],
+                    f"attribute '{attr}' of thread-spawning class "
+                    f"{cls.name} is written from {meths} with at least one "
+                    "write outside `with self._lock` — guard every write, "
+                    f"declare it in {_OWNED_DECL}, or suppress with a "
+                    "reasoned pragma"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R6: every chaos fault point referenced by a test
+# ---------------------------------------------------------------------------
+
+
+@rule("R6", "every serving/chaos.py fault point exercised by a test")
+def r6_chaos_coverage(project: Project) -> List[Finding]:
+    """A fault point nobody injects is a degradation contract nobody
+    checks. Every name in chaos.py's ``FAULTS`` tuple must appear in at
+    least one file under tests/."""
+    chaos = project.get("serving/chaos.py")
+    if chaos is None:
+        return []
+    out: List[Finding] = []
+    tests = project.tests_text()
+    for stmt in chaos.tree.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "FAULTS"):
+            continue
+        for elt in ast.walk(stmt.value):
+            name = _const_str(elt)
+            if name and name not in tests:
+                out.append(Finding(
+                    "R6", chaos.rel, elt.lineno,
+                    f"chaos fault point {name!r} is referenced by no test "
+                    "under tests/ — its degradation behavior is unchecked"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R7: every manifest-templated --flag accepted by its target CLI
+# ---------------------------------------------------------------------------
+
+_COMMAND_RE = re.compile(r"command:\s*\[(.*?)\]", re.DOTALL)
+_TOKEN_RE = re.compile(r'"([^"]*)"')
+
+_MODULE_PATHS = {
+    # python -m <module> -> repo-relative source file holding its argparse
+}
+
+
+def _module_to_rel(module: str) -> str:
+    return module.replace(".", "/") + ".py"
+
+
+def _cli_flags(src: SourceFile) -> Set[str]:
+    flags: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"):
+            continue
+        for arg in node.args:
+            s = _const_str(arg)
+            if s and s.startswith("-"):
+                flags.add(s)
+    return flags
+
+
+def r7_check_template(project: Project, rel: str,
+                      text: str) -> List[Finding]:
+    """Shared with deploy/validate_manifests.py: check one jinja template's
+    flow-style container commands against their targets' argparse CLIs."""
+    out: List[Finding] = []
+    for m in _COMMAND_RE.finditer(text):
+        tokens = _TOKEN_RE.findall(m.group(1))
+        if "-m" not in tokens:
+            continue
+        module = tokens[tokens.index("-m") + 1]
+        mod_rel = _MODULE_PATHS.get(module, _module_to_rel(module))
+        src = project._by_rel.get(mod_rel) or project.get(mod_rel)
+        line = text[:m.start()].count("\n") + 1
+        if src is None:
+            out.append(Finding(
+                "R7", rel, line,
+                f"container command targets module {module!r} whose source "
+                f"({mod_rel}) is not in the lint tree — cannot verify its "
+                "flags"))
+            continue
+        flags = _cli_flags(src)
+        for tok in tokens:
+            if tok.startswith("--") and tok not in flags:
+                tok_off = text.index(tok, m.start())
+                out.append(Finding(
+                    "R7", rel, text[:tok_off].count("\n") + 1,
+                    f"flag {tok!r} templated into the {module} container "
+                    "command is not accepted by that CLI "
+                    f"(no add_argument({tok!r}))"))
+    return out
+
+
+@rule("R7", "every manifest-templated flag exists in its target CLI")
+def r7_manifest_flags(project: Project) -> List[Finding]:
+    """A flag templated into a container command that its target argparse
+    doesn't accept is a CrashLoopBackOff discovered at rollout. Checked
+    offline against every flow-style ``command: [...]`` list in
+    deploy/manifests/*.j2 (block-style commands there are shell one-liners
+    with no module CLI)."""
+    import os as _os
+    out: List[Finding] = []
+    man_dir = _os.path.join(project.repo_root, "deploy", "manifests")
+    if not _os.path.isdir(man_dir):
+        return out
+    for fn in sorted(_os.listdir(man_dir)):
+        if not fn.endswith(".j2"):
+            continue
+        rel = f"deploy/manifests/{fn}"
+        text = project.read_artifact(rel)
+        if text:
+            out.extend(r7_check_template(project, rel, text))
+    return out
