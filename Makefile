@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests \
 	overload-smoke resume-smoke reconcile-smoke trace-smoke lint \
-	locksan-smoke
+	locksan-smoke aot-smoke
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -93,6 +93,8 @@ lint:
 			--follow-imports=silent \
 			aws_k8s_ansible_provisioner_tpu/serving/tracing.py \
 			aws_k8s_ansible_provisioner_tpu/serving/metrics.py \
+			aws_k8s_ansible_provisioner_tpu/serving/programs.py \
+			aws_k8s_ansible_provisioner_tpu/serving/aot.py \
 			deploy/state.py; \
 	else \
 		echo "lint: mypy not installed (pip install -e .[dev]) — type check skipped"; \
@@ -109,6 +111,16 @@ locksan-smoke:
 	env JAX_PLATFORMS=cpu TPU_LOCKSAN=1 $(PY) -m pytest \
 		tests/test_locksan.py tests/test_drain.py tests/test_chaos.py \
 		tests/test_router_e2e.py -q -p no:cacheprovider
+
+# AOT registry smoke (serving/aot.py): deviceless host-platform compile of
+# the full tiny-config program set through build_manifest — manifest schema
+# checked, per-program compile seconds recorded, HBM fit verdict asserted
+# both ways. Tier-1 runs the same tests (marker aot_smoke); the committed
+# Qwen3-8B v5e-8 artifact (AOT_QWEN3_8B_v5e8.json) is regenerated with
+#   python -m aws_k8s_ansible_provisioner_tpu.serving.aot --model Qwen/Qwen3-8B --tp 8
+aot-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m aot_smoke \
+		-p no:cacheprovider
 
 # Full bench field-plumbing proof on CPU (tiny model, ~15 s): one JSON line
 # with every real-run field (bblock, weights_dtype, dma_steps_per_substep,

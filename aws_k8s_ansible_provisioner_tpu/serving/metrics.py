@@ -201,6 +201,17 @@ class EngineMetrics:
         self.decode_bblock = r.register(Gauge(
             "tpu_serve_decode_bblock",
             "Decode kernel batch-block size (slots per grid step)"))
+        # Cold-start observability (serving/aot.py): warmup compile wall time
+        # and the AOT manifest's per-chip HBM ledger. A restart whose compile
+        # counter climbs by minutes is missing its persistent compilation
+        # cache / AOT manifest; a zero hbm gauge means no manifest was loaded.
+        self.compile_seconds = r.register(Counter(
+            "tpu_serve_compile_seconds_total",
+            "Wall seconds spent compiling programs at warmup"))
+        self.hbm_compiled_bytes = r.register(Gauge(
+            "tpu_serve_hbm_compiled_bytes",
+            "Per-chip HBM bytes the AOT manifest ledger accounts "
+            "(params + KV pool + max program temp)"))
         # Robustness layer (r7): overload shedding, end-to-end deadlines,
         # and the stall watchdog each get an explicit first-class signal —
         # a dashboard must distinguish "we refused work by design" from

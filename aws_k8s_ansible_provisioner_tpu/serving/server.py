@@ -344,6 +344,11 @@ class Handler(BaseHTTPRequestHandler):
                 "weights_dtype": eng.serving.weights_dtype,
                 "kv_dtype": eng.serving.kv_dtype,
                 "paged": bool(getattr(eng, "paged", False)),
+                # AOT manifest adoption summary (serving/aot.py): operators
+                # confirm the replica serves a pre-verified program set (and
+                # its HBM ledger headroom) straight off the probe; null means
+                # no manifest was loaded (plain lazy/warmup compilation).
+                "aot": getattr(eng, "aot", None),
                 # Robustness counters (r7): operators (and the chaos suite)
                 # read shed/deadline/stall/preemption totals here without a
                 # /metrics scrape+parse.
@@ -1633,6 +1638,11 @@ def main(argv=None):
                    help="root-span sampling probability in [0, 1]; "
                         "propagated contexts keep the caller's decision")
     p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--aot-manifest", default="",
+                   help="AOT compile manifest (serving/aot.py) to adopt: "
+                        "fingerprint-checked against this engine, HBM fit "
+                        "enforced, ledger surfaced on /healthz and the "
+                        "tpu_serve_hbm_compiled_bytes gauge")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
 
@@ -1687,6 +1697,16 @@ def main(argv=None):
         trace_sample=args.trace_sample,
         mesh=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep))
     state = build_state(serving)
+    if args.aot_manifest:
+        # Fail fast BEFORE warmup: a mismatched or no-fit manifest means the
+        # deploy pipeline compiled a different program set than this engine
+        # would dispatch — compiling anyway just delays the error to OOM.
+        aot = state.engine.load_aot_manifest(args.aot_manifest)
+        log.info("AOT manifest adopted: %d programs, %.1fs compile on "
+                 "%s, HBM %.2f GiB/chip (headroom %.2f GiB)",
+                 aot["programs"], aot["total_compile_seconds"],
+                 aot["platform"], aot["hbm_total_bytes"] / 2**30,
+                 aot["hbm_headroom_bytes"] / 2**30)
     if not args.no_warmup:
         log.info("warmup: compiling %d prefill buckets + decode ...",
                  len(state.engine.buckets))

@@ -665,9 +665,128 @@ def measure() -> None:
                        **link})
 
 
+def _coldstart_child() -> None:
+    """One time-to-ready sample in a FRESH process: build the tiny engine
+    and run full warmup against the compile cache dir the parent chose
+    (TPU_BENCH_CACHE_DIR; empty = cold). With TPU_BENCH_AOT_MANIFEST set,
+    adopt the manifest first — the server's exact start sequence. Prints one
+    JSON line: {"ready_s", "warmup_s"}."""
+    import jax
+
+    cache_dir = os.environ.get("TPU_BENCH_CACHE_DIR", "")
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # CPU programs compile in ~1s each; the server's 1.0s threshold
+        # would cache only some of them and make warm-vs-cold noise.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    import jax.numpy as jnp
+
+    from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+    from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+    from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine
+
+    t0 = time.monotonic()
+    cfg = tiny_qwen3()
+    serving = ServingConfig(model="tiny-qwen3", max_decode_slots=4,
+                            max_cache_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.bfloat16)
+    engine = Engine(cfg, params, serving)
+    manifest = os.environ.get("TPU_BENCH_AOT_MANIFEST", "")
+    if manifest:
+        engine.load_aot_manifest(manifest)
+    t1 = time.monotonic()
+    engine.warmup()
+    ready = time.monotonic()
+    print(json.dumps({"ready_s": round(ready - t0, 2),
+                      "warmup_s": round(ready - t1, 2)}), flush=True)
+
+
+def coldstart() -> None:
+    """Time-to-ready A/B/C: cache-cold vs cache-warm vs AOT-preloaded.
+
+    Three fresh child processes build the same tiny engine + full warmup:
+      cold  — empty persistent compile cache (every program pays XLA);
+      warm  — the cache the cold run just populated (container-restart case);
+      aot   — a cache populated by `serving.aot --cache-dir` plus manifest
+              adoption, with NO prior engine run (fresh-replica case: the
+              deploy pipeline compiled, the pod never has).
+    Writes BENCH_coldstart_r01.json; warm and aot must beat cold outright —
+    that delta IS the cold-start elimination this subsystem ships.
+    """
+    import shutil
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="coldstart-")
+    env_base = {**os.environ, "JAX_PLATFORMS":
+                os.environ.get("JAX_PLATFORMS", "cpu")}
+
+    def child(cache_dir: str, manifest: str = "") -> dict:
+        env = {**env_base, "TPU_BENCH_CACHE_DIR": cache_dir}
+        if manifest:
+            env["TPU_BENCH_AOT_MANIFEST"] = manifest
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--coldstart-child"],
+            env=env, capture_output=True, text=True, timeout=600, cwd=here)
+        if p.returncode != 0:
+            raise RuntimeError(f"coldstart child failed:\n{p.stdout}\n"
+                               f"{p.stderr}")
+        return json.loads(p.stdout.strip().splitlines()[-1])
+
+    try:
+        shared = os.path.join(work, "cache")
+        cold = child(shared)             # populates `shared` as it compiles
+        warm = child(shared)             # container-restart: same cache
+        aot_cache = os.path.join(work, "aot-cache")
+        manifest = os.path.join(work, "aot.json")
+        t0 = time.monotonic()
+        p = subprocess.run(
+            [sys.executable, "-m",
+             "aws_k8s_ansible_provisioner_tpu.serving.aot",
+             "--model", "tiny-qwen3", "--platform", "host", "--tp", "1",
+             "--slots", "4", "--max-cache-len", "64", "--quiet",
+             "--cache-dir", aot_cache, "--out", manifest],
+            env=env_base, capture_output=True, text=True, timeout=600,
+            cwd=here)
+        if p.returncode != 0:
+            raise RuntimeError(f"aot compile failed:\n{p.stdout}\n{p.stderr}")
+        aot_compile_s = round(time.monotonic() - t0, 2)
+        aot = child(aot_cache, manifest=manifest)  # fresh replica + manifest
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    out = {
+        "bench": "coldstart", "rev": "r01",
+        "model": "tiny-qwen3", "platform": env_base["JAX_PLATFORMS"],
+        "cold_ready_s": cold["ready_s"], "cold_warmup_s": cold["warmup_s"],
+        "warm_ready_s": warm["ready_s"], "warm_warmup_s": warm["warmup_s"],
+        "aot_ready_s": aot["ready_s"], "aot_warmup_s": aot["warmup_s"],
+        # deploy-time cost that buys the aot_ready_s floor (runs once per
+        # config in the pipeline, not per replica)
+        "aot_compile_s": aot_compile_s,
+        "warm_speedup": round(cold["ready_s"] / max(0.01, warm["ready_s"]),
+                              2),
+        "aot_speedup": round(cold["ready_s"] / max(0.01, aot["ready_s"]), 2),
+    }
+    print(json.dumps(out), flush=True)
+    if not (warm["ready_s"] < cold["ready_s"]
+            and aot["ready_s"] < cold["ready_s"]):
+        raise SystemExit(f"coldstart bench: cache/AOT start did not beat "
+                         f"cold ({out})")
+    path = os.path.join(here, "BENCH_coldstart_r01.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+
+
 if __name__ == "__main__":
     if "--measure" in sys.argv:
         measure()
+    elif "--coldstart-child" in sys.argv:
+        _coldstart_child()
+    elif "--coldstart" in sys.argv:
+        coldstart()
     elif "--dry" in sys.argv:
         # Seconds-class CPU pass over the tiny model, in-process: proves the
         # whole field plumbing (bblock, weights_dtype, dma_steps_per_substep,

@@ -129,6 +129,28 @@ def _validate_workload(d: dict, name: str):
                             "passes --otlp-endpoint but does not set the "
                             "OTEL_EXPORTER_OTLP_ENDPOINT env var "
                             "(serving/tracing.py's fallback contract)")
+        # Compile-cache pairing (AOT cold-start work, serving/aot.py): a
+        # JAX_COMPILATION_CACHE_DIR env must point INSIDE a declared
+        # volumeMount of the same container — a cache on the container's
+        # writable layer silently evaporates on every restart, re-paying
+        # the multi-minute warmup this env exists to eliminate (and making
+        # an AOT-populated cache unreachable).
+        for e in c.get("env") or []:
+            if e.get("name") != "JAX_COMPILATION_CACHE_DIR":
+                continue
+            cache_dir = (e.get("value") or "").rstrip("/")
+            if not cache_dir:
+                continue   # valueFrom / empty: nothing checkable offline
+            mounts = [(vm.get("mountPath") or "").rstrip("/")
+                      for vm in c.get("volumeMounts") or []]
+            if not any(mp and (cache_dir == mp
+                               or cache_dir.startswith(mp + "/"))
+                       for mp in mounts):
+                _fail(name, f"{kind} {mname} container {c.get('name')} "
+                            f"sets JAX_COMPILATION_CACHE_DIR="
+                            f"{cache_dir!r} but no volumeMount covers that "
+                            "path — the compile cache would die with the "
+                            "container (see serving.yaml.j2 xla-cache)")
 
 
 def kubeconform_validate(text: str, name: str) -> bool:

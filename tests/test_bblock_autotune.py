@@ -12,21 +12,28 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.config import (
+    MeshConfig, ServingConfig, tiny_qwen3)
 from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+from aws_k8s_ansible_provisioner_tpu.parallel.mesh import make_mesh
 from aws_k8s_ansible_provisioner_tpu.serving import engine as eng_mod
 from aws_k8s_ansible_provisioner_tpu.serving.engine import (
     Engine, pick_decode_bblock)
 
 
-def _mk_engine(monkeypatch=None, page_size=8, slots=8, **srv):
+def _mk_engine(monkeypatch=None, page_size=8, slots=8, mesh=None, **srv):
     cfg = tiny_qwen3()
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
     serving = ServingConfig(model="tiny-qwen3", max_decode_slots=slots,
                             max_cache_len=64, page_size=page_size,
                             dtype="float32", weights_dtype="bf16",
                             prefill_buckets=(16,), **srv)
-    return Engine(cfg, params, serving)
+    return Engine(cfg, params, serving, mesh=mesh)
+
+
+def _mk_mesh_engine(dp=2, **srv):
+    mesh = make_mesh(MeshConfig(dp=dp, tp=1), devices=jax.devices("cpu")[:dp])
+    return _mk_engine(mesh=mesh, **srv)
 
 
 class _FakeTimer:
@@ -138,6 +145,63 @@ def test_explicit_pin_skips_bench(monkeypatch):
     assert _mk_engine(slots=6, decode_bblock=8).decode_bblock == 6  # clamp
     monkeypatch.setenv("PALLAS_DECODE_BBLOCK", "2")
     assert _mk_engine(decode_bblock=4).decode_bblock == 2  # env wins (A/B)
+
+
+def test_mesh_autotune_uses_shardmap_bench_and_per_mesh_cache(
+        monkeypatch, cpu_devices):
+    """ROADMAP gap closed: a dp mesh engine autotunes through the shard_map
+    bench (never the unsharded direct-kernel one) and caches its winner
+    under a mesh-extended key, leaving the single-device key untouched."""
+    eng_mod._BBLOCK_CACHE.clear()
+    calls = []
+
+    def boom(self, bb):
+        raise AssertionError("mesh engine benched the unsharded kernel path")
+
+    monkeypatch.setattr(Engine, "_bblock_autotune_supported",
+                        lambda self: True)
+    monkeypatch.setattr(Engine, "_bblock_bench_once", boom)
+    monkeypatch.setattr(Engine, "_bblock_bench_once_mesh",
+                        lambda self, bb: calls.append(bb))
+    # bb=8 fastest: medians 9 (bb=1), 5 (bb=4), 2 (bb=8)
+    monkeypatch.setattr(Engine, "_bblock_timer",
+                        staticmethod(_FakeTimer([9, 9, 9, 5, 5, 5, 2, 2, 2])))
+    engine = _mk_mesh_engine(dp=2)
+    assert engine.decode_bblock == 8
+    assert calls == [1, 1, 1, 1, 4, 4, 4, 4, 8, 8, 8, 8]
+    key = engine._bblock_cache_key()
+    assert key[3] == tuple(sorted(engine.mesh.shape.items()))
+    assert eng_mod._BBLOCK_CACHE[key] == 8
+    assert (8, 8, "bf16") not in eng_mod._BBLOCK_CACHE
+    # same mesh shape => pure cache hit, no re-bench
+    n = len(calls)
+    assert _mk_mesh_engine(dp=2).decode_bblock == 8
+    assert len(calls) == n
+
+
+def test_mesh_synthetic_bench_table_stays_in_group_partition(cpu_devices):
+    """The mesh bench's synthetic block table must hand each slot GLOBAL
+    page ids inside its own dp group's pool partition (past the group
+    scratch page) — the shard_map body rebases them to local ids, so an
+    out-of-partition id would read another group's pages."""
+    eng_mod._BBLOCK_CACHE.clear()
+    engine = _mk_mesh_engine(dp=2)
+    tab = engine._bblock_synthetic_table()
+    total = engine.cache["k"].shape[1]
+    gp, spg = total // 2, engine.num_slots // 2
+    for s in range(engine.num_slots):
+        g = s // spg
+        assert tab[s].min() >= g * gp + 1, f"slot {s} touches scratch/other"
+        assert tab[s].max() < (g + 1) * gp, f"slot {s} leaves its partition"
+
+
+def test_mesh_bench_dispatch_runs_interpret(cpu_devices):
+    """The shard_map bench itself must dispatch end-to-end (interpret-mode
+    Pallas on the CPU mesh): a real guard against drift between the bench
+    wrapper and make_decode_attend_carry_paged's signature."""
+    eng_mod._BBLOCK_CACHE.clear()
+    engine = _mk_mesh_engine(dp=2)
+    engine._bblock_bench_once_mesh(1)
 
 
 def test_bblock_reported_on_gauge_and_used_by_decode():

@@ -423,6 +423,35 @@ def test_admission_pressure_preempts_lowest_progress():
     _assert_released(eng)
 
 
+def test_pressure_preempting_the_only_active_slot_is_still_work():
+    """Regression (r8, the order-dependent test_engine_mesh wedge): when
+    pressure relief preempts the SOLE active request, that step must return
+    True — it returned False with the queue non-empty, so every driver that
+    treats a False step as quiescence (run_forever's idle sleep, the test
+    suites' ``if not eng.step(): break`` loops) stranded the requeued
+    victim. Deterministic replay of what full-suite CPU contention did to
+    the mesh test: steps slower than admission_preempt_after_s."""
+    eng, tok = _mk_engine(kv_pool_pages=4, max_cache_len=128, page_size=32,
+                          admission_preempt_after_s=0.005)
+    hog = eng.generate([65] * 120, max_tokens=7, ignore_eos=True)
+    while not eng._active_slots():
+        eng.step()
+    blocked = eng.generate(tok.encode("starved head"), max_tokens=2)
+    eng.step()                  # blocked admission: pressure timer starts
+    time.sleep(0.02)
+    assert eng.step() is True, \
+        "the step that preempted the only active slot reported no work"
+    assert eng.metrics.admission_preemptions.total() == 1
+    assert not eng._active_slots()      # victim gone — queue must revive it
+    for _ in range(10000):              # the drivers' quiescence loop
+        if not eng.step():
+            break
+    assert blocked.finish_reason in ("stop", "length")
+    assert hog.finish_reason in ("stop", "length")
+    assert len(hog.generated) == 7
+    _assert_released(eng)
+
+
 # ---------------------------------------------------------------------------
 # Client-side faults: mid-stream disconnect, slow client
 # ---------------------------------------------------------------------------

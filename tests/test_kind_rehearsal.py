@@ -188,6 +188,74 @@ def test_render_carries_robustness_knobs():
         str(ServingConfig.max_queue_depth)
 
 
+def test_render_aot_manifest_both_branches():
+    """--aot-manifest is var-gated: absent by default (lazy warmup), wired
+    verbatim when serving_aot_manifest is set — and the flagged render still
+    passes the validator (R7 cross-checks the flag against the server CLI)."""
+    import sys
+    sys.path.insert(0, str(REPO / "deploy"))
+    import validate_manifests as vm
+
+    def engine_cmd(docs):
+        eng = next(d for d in docs if d["kind"] == "Deployment"
+                   and d["metadata"]["name"] == "tpu-serving-engine")
+        return eng["spec"]["template"]["spec"]["containers"][0]["command"]
+
+    assert "--aot-manifest" not in engine_cmd(_render())
+    docs = _render(serving_aot_manifest="/app/AOT_QWEN3_8B_v5e8.json")
+    cmd = engine_cmd(docs)
+    assert cmd[cmd.index("--aot-manifest") + 1] == \
+        "/app/AOT_QWEN3_8B_v5e8.json"
+    from aws_k8s_ansible_provisioner_tpu.config import render_manifest
+    text = render_manifest(
+        str(REPO / "deploy" / "manifests" / "serving.yaml.j2"),
+        serving_aot_manifest="/app/AOT_QWEN3_8B_v5e8.json")
+    assert vm.structural_validate(text, "aot-flagged") > 0
+
+
+def test_cache_dir_volume_coherence_rule():
+    """JAX_COMPILATION_CACHE_DIR must land on a mounted volume: a cache on
+    the container's writable layer dies with every restart, re-paying the
+    warmup the AOT/cache machinery exists to eliminate."""
+    import sys
+    sys.path.insert(0, str(REPO / "deploy"))
+    import validate_manifests as vm
+
+    tmpl = """
+apiVersion: apps/v1
+kind: Deployment
+metadata: {name: d}
+spec:
+  selector: {matchLabels: {app: x}}
+  template:
+    metadata: {labels: {app: x}}
+    spec:
+      containers:
+        - name: c
+          image: img
+          env:
+            - name: JAX_COMPILATION_CACHE_DIR
+              value: %s
+          volumeMounts:
+            - {name: cache, mountPath: /var/cache/xla}
+      volumes:
+        - {name: cache, emptyDir: {}}
+"""
+    # exact mount, nested path, and trailing-slash forms all cohere
+    for ok in ("/var/cache/xla", "/var/cache/xla/engine",
+               "/var/cache/xla/"):
+        assert vm.structural_validate(tmpl % ok, "ok") == 1
+    # uncovered path (and the sneaky sibling-prefix case) must fail
+    for bad in ("/tmp/elsewhere", "/var/cache/xlab"):
+        with pytest.raises(vm.ManifestError, match="JAX_COMPILATION"):
+            vm.structural_validate(tmpl % bad, "bad")
+    # the shipped template itself carries the env+mount pair coherently
+    for name, text in vm._render_all():
+        if name.startswith("serving"):
+            assert "JAX_COMPILATION_CACHE_DIR" in text
+            vm.structural_validate(text, name)
+
+
 def _playbook_request_sequence():
     """(method, path, payload, assert_fn) tuples mirroring
     deploy/serving-test.yaml's request tasks."""
