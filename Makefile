@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests \
 	overload-smoke resume-smoke reconcile-smoke trace-smoke lint \
-	locksan-smoke aot-smoke
+	locksan-smoke aot-smoke pipeline-smoke
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -111,6 +111,17 @@ locksan-smoke:
 	env JAX_PLATFORMS=cpu TPU_LOCKSAN=1 $(PY) -m pytest \
 		tests/test_locksan.py tests/test_drain.py tests/test_chaos.py \
 		tests/test_router_e2e.py -q -p no:cacheprovider
+
+# Decode-pipeline smoke (serving/programs.py one-deep async pipeline):
+# seeded golden streams byte-identical pipeline on vs off, lifecycle edges
+# (cancel/deadline/chunk/drain), injected fetch failure recovery — run
+# LockSan-instrumented, since the pipeline adds engine-thread state
+# (_inflight/_pipe_carry) whose single-writer contract LockSan verifies at
+# runtime. Tier-1 runs the same tests (marker pipeline_smoke) without the
+# env.
+pipeline-smoke:
+	env JAX_PLATFORMS=cpu TPU_LOCKSAN=1 $(PY) -m pytest \
+		tests/test_decode_pipeline.py -q -p no:cacheprovider
 
 # AOT registry smoke (serving/aot.py): deviceless host-platform compile of
 # the full tiny-config program set through build_manifest — manifest schema

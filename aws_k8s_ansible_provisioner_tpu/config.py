@@ -603,6 +603,16 @@ class ServingConfig:
     # Fused decode horizon: tokens generated per device dispatch when no
     # prefill is waiting (amortizes dispatch latency; see engine.decode_steps).
     decode_horizon: int = 8
+    # One-deep asynchronous decode pipeline: the engine enqueues decode
+    # dispatch N+1 (JAX async dispatch — no block) before fetching N's
+    # tokens, so the host emit/SSE/scheduling gap overlaps device compute
+    # instead of leaving the chip idle for ~an RTT per dispatch. The sampled
+    # token / length carry stays device-resident across dispatches (donated,
+    # no host round-trip) and device operand uploads are cached behind dirty
+    # flags. Seeded streams are byte-identical either way (keys are
+    # position-derived). 0 restores the strictly synchronous dispatch→fetch
+    # path (debugging, exact wall-clock attribution per dispatch).
+    decode_pipeline: int = 1
     # Paged KV cache geometry.
     page_size: int = 64
     # True paged KV (vLLM's on-demand block allocation; serving/paged_kv.py):
@@ -854,6 +864,9 @@ def ansible_vars(cfg: FrameworkConfig | None = None,
     d["serving_kv_dtype"] = cfg.serving.kv_dtype
     d["serving_weights_dtype"] = cfg.serving.weights_dtype
     d["serving_spec_decode"] = cfg.serving.spec_decode
+    # Decode pipeline depth (perf_opt r9): the manifest passes it to
+    # --decode-pipeline so a fleet can A/B or pin the synchronous path.
+    d["serving_decode_pipeline"] = cfg.serving.decode_pipeline
     # Robustness knobs (r7): the manifests pass these to the engine CLI so
     # the deadline/admission behavior is deploy-configurable from the same
     # single source.

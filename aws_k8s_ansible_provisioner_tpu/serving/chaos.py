@@ -37,6 +37,13 @@ fault                       defined degradation behavior
                             from the backend raises after ``after_events``
                             relayed events — drives the failover path
                             without any server cooperation
+``pipeline_fetch_error``    the deferred fetch of a pipelined decode
+                            dispatch fails (transfer/XLA fault at the
+                            block point): the in-flight dispatch is
+                            discarded, its requests fail with "error"
+                            through the normal teardown (slots/pages
+                            released exactly once) and the engine keeps
+                            serving
 ``span_export``             the OTLP trace collector misbehaves — refuses
                             connections, hangs, or answers 5xx (``mode``) —
                             only the exporter's background thread sees it:
@@ -76,7 +83,7 @@ from typing import Dict, Optional
 
 FAULTS = ("connect_refused", "stalled_decode", "page_exhaustion",
           "slow_client", "mid_stream_disconnect", "kill_stream",
-          "stream_read_error", "span_export")
+          "stream_read_error", "span_export", "pipeline_fetch_error")
 
 
 class InjectedFault(RuntimeError):
@@ -191,6 +198,20 @@ class ChaosController:
                     "chaos: stalled decode step aborted by watchdog after "
                     f"{time.monotonic() - t0:.2f}s")
             time.sleep(0.005)
+
+    def on_pipeline_fetch(self, engine) -> None:
+        """EnginePrograms._decode_fetch entry: an armed
+        ``pipeline_fetch_error`` raises in place of the blocking device
+        read — standing in for a transfer/XLA failure that only surfaces at
+        the deferred block point of an asynchronously-dispatched program.
+        step() unwinds, run_forever's catch-all fails the affected requests
+        (_fail_all discards the in-flight record first so nothing re-fetches
+        the poisoned dispatch) and the engine keeps serving."""
+        p = self.fire("pipeline_fetch_error")
+        if p is None:
+            return
+        raise InjectedFault(
+            "chaos: injected pipelined decode fetch failure")
 
     def on_engine_step(self, engine) -> None:
         """engine.step entry: an armed ``page_exhaustion`` makes the page

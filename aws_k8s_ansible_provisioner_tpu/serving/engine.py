@@ -223,6 +223,9 @@ class Engine(EnginePrograms):
         "_slot_pages", "_slot_tokens", "_chunk",
         "_chunk_yield", "_prefill_streak", "_admission_blocked_since",
         "_tok_times", "_admit_seq", "_seq_counter", "prompt_mask",
+        "_inflight", "_pipe_carry", "_carry_gen", "_op_cache",
+        "_op_dirty_sampling", "_op_dirty_table", "_last_ready",
+        "_busy_watermark",
     )
 
     def __init__(self, cfg: ModelConfig, params, serving: ServingConfig,
@@ -371,6 +374,31 @@ class Engine(EnginePrograms):
         # cancels stragglers through the existing deadline path.
         self.draining = False
         self._drain_deadline = 0.0
+        # One-deep asynchronous decode pipeline (perf_opt r9): the engine
+        # enqueues decode N+1 before fetching N's tokens, so the host
+        # emit/SSE/scheduling gap overlaps device compute.
+        # _inflight: the dispatched-but-unfetched decode record (see
+        # EnginePrograms._decode_dispatch); _pipe_carry: its device-resident
+        # (last_token, lengths, carry_gen) end state, consumed by the next
+        # dispatch when _carry_gen still matches; _carry_gen bumps on every
+        # slot-lifecycle transition that rewrites state out of band of the
+        # carry (activate/preempt/chunk start).
+        self._inflight: Optional[dict] = None
+        self._pipe_carry = None
+        self._carry_gen = 0
+        # Device operand-upload cache (seeds/ban/bias/penalties/table...):
+        # re-uploaded only when the dirty flags say the host mirrors
+        # changed, instead of per dispatch (EnginePrograms._decode_operands)
+        self._op_cache: dict = {}
+        self._op_dirty_sampling = True
+        self._op_dirty_table = True
+        # Bubble accounting: _last_ready marks a fetch completing with
+        # nothing enqueued behind it (device going idle); the next dispatch
+        # books the gap on decode_bubble_seconds. _busy_watermark is the
+        # device-time high-water mark so overlapped dispatches never
+        # double-count device_busy_seconds.
+        self._last_ready = 0.0
+        self._busy_watermark = 0.0
 
 
     @staticmethod
@@ -547,6 +575,7 @@ class Engine(EnginePrograms):
         self._resume_ctx.pop(req.id, None)
         pages = matched + list(fresh)
         self._slot_pages[slot] = pages
+        self._op_dirty_table = True
         self.table[slot, :] = self._scratch[slot]
         self.table[slot, :len(pages)] = \
             np.asarray(pages, np.int32) + self._gbase(slot)
@@ -590,6 +619,7 @@ class Engine(EnginePrograms):
             return
         self._alloc(slot).release_all(self._slot_pages[slot])
         self._slot_pages[slot] = []
+        self._op_dirty_table = True
         self.table[slot, :] = self._scratch[slot]
         self.lengths[slot] = 0
         self._pages_gauges()
@@ -624,6 +654,7 @@ class Engine(EnginePrograms):
                 need = -(-rows // ps) - len(pages)
                 got = self._alloc(slot).alloc(need)
                 if got is not None:
+                    self._op_dirty_table = True
                     self.table[slot, len(pages):len(pages) + need] = \
                         np.asarray(got, np.int32) + self._gbase(slot)
                     pages.extend(got)
@@ -659,6 +690,10 @@ class Engine(EnginePrograms):
         self._index_prompt_pages(slot, ids, n_valid=len(ids) - 1)
         self._resume_ctx[req.id] = ids
         self.slot_req[slot] = None
+        # the preempted slot's host state diverges from any in-flight
+        # dispatch's device carry, and its sampling rows are rewritten
+        self._carry_gen += 1
+        self._op_dirty_sampling = True
         self.temps[slot] = 0.0
         self.pres_pens[slot] = 0.0
         self.freq_pens[slot] = 0.0
@@ -1018,6 +1053,12 @@ class Engine(EnginePrograms):
             self._prefill_streak = 0
             self._do_decode(fair_horizon=True)
             return True
+        # Pipelined decode: settle the in-flight dispatch (its deferred
+        # emits, possible finishes) BEFORE admission can reuse a freed slot
+        # or start a chunk — slot reuse under unfetched tokens would
+        # mis-route the deferred emits to the new request.
+        if self._inflight is not None and self.sched.stats().queue_depth > 0:
+            self._drain_decode_pipeline()
         # Admission decisions come from the runtime core (FCFS; skips
         # cancelled-in-queue requests, surfacing them for client notification).
         # Bucket-fitting prompts batch into one dispatch; a chunk-needing
@@ -1155,6 +1196,12 @@ class Engine(EnginePrograms):
         if self._active_slots():
             self._do_decode()
             return True
+        if self._inflight is not None:
+            # cancel/deadline reaps emptied the batch with a dispatch still
+            # in flight: settle it (all its emits discard) so nothing stays
+            # enqueued on the device across idle or drain periods
+            self._drain_decode_pipeline()
+            return True
         return False
 
     def _emit(self, slot: int, token: int, lp=None):
@@ -1208,6 +1255,12 @@ class Engine(EnginePrograms):
         # RELEASED below — indexed ones stay prefix-matchable in the
         # evictable LRU — and the zeroed table points idle writes at the
         # scratch page, so the length resets to 0 there.)
+        # NOTE: a finish does NOT bump _carry_gen — an in-flight pipelined
+        # dispatch keeps decoding the freed slot as discardable garbage
+        # (scratch-table writes, emits skipped); only a REUSE (_activate)
+        # invalidates the device carry. The cleared sampling rows do dirty
+        # the operand cache for the next upload.
+        self._op_dirty_sampling = True
         self.temps[slot] = 0.0
         self.pres_pens[slot] = 0.0
         self.freq_pens[slot] = 0.0
@@ -1291,6 +1344,13 @@ class Engine(EnginePrograms):
         return dt if dt >= self.STALL_AFTER_S else 0.0
 
     def _fail_all(self, reason: str):
+        # Discard the in-flight pipelined decode outright: its requests are
+        # failed below through the normal slot teardown (exactly-once page/
+        # slot release via _finish), and fetching a dispatch that may BE the
+        # failure (pipeline_fetch_error, transfer fault) would re-raise.
+        self._inflight = None
+        self._pipe_carry = None
+        self.metrics.pipeline_depth.set(0.0)
         if self._chunk is not None:  # fail the half-prefilled request too
             st, self._chunk = self._chunk, None
             self._release_slot_pages(st["slot"])

@@ -156,10 +156,23 @@ class EngineMetrics:
             "tpu_serve_time_to_first_token_seconds", "Time to first token"))
         self.decode_step_duration = r.register(Histogram(
             "tpu_serve_decode_step_seconds",
-            "Per-token decode latency over all slots (dispatch time / horizon)",
+            "Per-token decode DEVICE time over all slots (device window / "
+            "horizon; wall time includes pipeline overlap and host bubble)",
             buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5, 1., 2.5)))
         self.tokens_per_second = r.register(Gauge(
             "tpu_serve_tokens_per_second", "Recent decode throughput"))
+        # Decode pipeline (perf_opt r9): bubble = device idle between a
+        # dispatch completing with nothing enqueued behind it and the next
+        # enqueue (host emit/SSE/scheduling time). Synchronous mode pays it
+        # every dispatch; the one-deep pipeline hides it behind device
+        # compute, so bubble-rate ~0 is the success signal.
+        self.decode_bubble_seconds = r.register(Counter(
+            "tpu_serve_decode_bubble_seconds_total",
+            "Device idle seconds between decode dispatches (host bubble)"))
+        self.pipeline_depth = r.register(Gauge(
+            "tpu_serve_pipeline_depth",
+            "Decode dispatches currently in flight past the fetched one "
+            "(1 = pipelined steady state, 0 = synchronous/drained)"))
         # Wall time spent inside device dispatches (prefill + decode). The
         # node metrics exporter scrapes this across the process boundary and
         # derives tpu_duty_cycle_percent from its rate — the engine process

@@ -419,7 +419,7 @@ def prefill_chunk_step(cfg: ModelConfig, params, cache, tokens, start, slot,
                                                           "logprobs",
                                                           "penalties",
                                                           "bblock"),
-         donate_argnums=(3,), donate_argnames=("counts",))
+         donate_argnums=(3, 4, 5), donate_argnames=("counts",))
 def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
                  lengths, rng, temperature, top_k, top_p, mesh=None,
                  impl: str = "auto", logprobs: bool = False,
@@ -431,7 +431,11 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
                  bblock: int = 1):
     """``n_steps`` fused decode steps for every slot, one device dispatch.
 
-    tokens/lengths/sampling params: [B]. Returns (cache, out [n_steps, B]).
+    tokens/lengths/sampling params: [B]. Returns
+    (cache, counts, out [n_steps, B], last_tok [B], lens [B]) — the final
+    token/length carry stays device-resident so a pipelined engine can feed
+    dispatch N's carry straight into dispatch N+1 (donated, no host
+    round-trip; see EnginePrograms._decode_dispatch).
 
     Fusing the token loop into one ``lax.scan`` is a TPU-first scheduling
     decision: per-dispatch host→device latency (worst over a network-attached
@@ -501,9 +505,9 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
         counts = jnp.zeros((tokens.shape[0], 1), jnp.int32)  # unused dummy
     rngs = jax.random.split(rng, n_steps)
     with lora_context(lora_idx):
-        (cache, counts, _, _), out = jax.lax.scan(
+        (cache, counts, tok, lens), out = jax.lax.scan(
             body, (cache, counts, tokens, lengths), rngs)
-    return cache, counts, out
+    return cache, counts, out, tok, lens
 
 
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("impl", "mesh",
@@ -973,6 +977,7 @@ class EnginePrograms:
         token already honors both — filling only at _activate would let it
         escape suppression/bias) and again at _activate (idempotent; covers
         the preemption-resume path)."""
+        self._op_dirty_sampling = True
         self.ban_ids[slot, :] = 2**31 - 1
         if req.min_tokens > 0:
             bs = sorted(self._ban_set(req))[:BAN_K]
@@ -1062,6 +1067,11 @@ class EnginePrograms:
         seeded key it would have used without the preemption — bit-identical
         streams either way."""
         ids = list(req.prompt_ids) if ids is None else ids
+        # an in-flight decode dispatch's device carry (token/length) no
+        # longer describes the batch once this slot joins it; its sampling
+        # operand rows change too
+        self._carry_gen += 1
+        self._op_dirty_sampling = True
         now = time.monotonic()
         if not req.t_first_token:     # don't re-observe on preemption resume
             req.t_first_token = now
@@ -1297,6 +1307,9 @@ class EnginePrograms:
         prompt + generated for a preemption resume.
         """
         self._fill_sampling_rows(req, slot)   # before the first chunk dispatch
+        # chunking rewrites the slot's length out of band of any decode
+        # carry (admission already drained the pipeline; belt-and-braces)
+        self._carry_gen += 1
         if self.draft is not None:
             # the draft has no chunk walk; the slot serves the plain path
             self.draft.mark_stale(slot)
@@ -1530,6 +1543,73 @@ class EnginePrograms:
             if span > 0:
                 self.metrics.tokens_per_second.set(toks / span)
 
+    def _pipeline_on(self) -> bool:
+        """May a decode dispatch be left in flight after this step?
+
+        Only on the plain decode path: spec decode proposes from host
+        mirrors (they must be current), chunked prefill interleaves
+        horizon-1 decodes against a half-built slot, and a draining engine
+        must hit "nothing in flight" the moment its last emit goes out.
+        """
+        return (self.serving.decode_pipeline > 0
+                and not self.serving.spec_decode
+                and self._chunk is None
+                and not self.draining)
+
+    def _carry_valid(self) -> bool:
+        """True while the device-resident token/length carry of the
+        in-flight dispatch still describes the batch — no slot was
+        activated, preempted, or otherwise rewritten since it was
+        enqueued (every such transition bumps ``_carry_gen``)."""
+        return (self._pipe_carry is not None
+                and self._pipe_carry[2] == self._carry_gen)
+
+    def _drain_decode_pipeline(self) -> None:
+        """Fetch + emit the in-flight decode dispatch, if any.
+
+        Every transition that reads or rewrites slot state out of band of
+        the device carry must drain first: prefill admission (slot reuse
+        would mis-route the deferred emits), chunk start, spec decode,
+        drain/failover. The device carry is dropped with it; the next
+        dispatch re-uploads token/length from the now-fresh host mirrors.
+        """
+        rec = self._inflight
+        if rec is None:
+            return
+        self._inflight = None
+        self._pipe_carry = None
+        self.metrics.pipeline_depth.set(0.0)
+        self._decode_fetch(rec, tail=True)
+
+    def _decode_operands(self):
+        """Device-resident sampling/table operands for decode dispatches.
+
+        Re-uploaded only when the host mirrors changed (dirty flags set on
+        slot activate/finish/preempt and at every block-table write) —
+        re-``jnp.asarray``-ing ~10 arrays per dispatch put serial host
+        uploads on the critical path of every decode, visible at the
+        89.5 ms-RTT class latencies of a network-attached chip.
+        """
+        oc = self._op_cache
+        if self._op_dirty_sampling or "temps" not in oc:
+            oc["temps"] = jnp.asarray(self.temps)
+            oc["top_ks"] = jnp.asarray(self.top_ks)
+            oc["top_ps"] = jnp.asarray(self.top_ps)
+            oc["seeds"] = jnp.asarray(self.seeds)
+            oc["ban_ids"] = jnp.asarray(self.ban_ids)
+            oc["ban_until"] = jnp.asarray(self.ban_until)
+            oc["bias_ids"] = jnp.asarray(self.bias_ids)
+            oc["bias_vals"] = jnp.asarray(self.bias_vals)
+            oc["pres"] = jnp.asarray(self.pres_pens)
+            oc["freq"] = jnp.asarray(self.freq_pens)
+            oc["rep"] = jnp.asarray(self.rep_pens)
+            oc["lora"] = self._lora_vec()
+            self._op_dirty_sampling = False
+        if self.paged and (self._op_dirty_table or "table" not in oc):
+            oc["table"] = jnp.asarray(self.table)
+            self._op_dirty_table = False
+        return oc
+
     def _do_decode(self, max_horizon: Optional[int] = None,
                    fair_horizon: bool = False):
         ch = _chaos.get()
@@ -1537,8 +1617,15 @@ class EnginePrograms:
             # an armed "stalled_decode" wedges here (standing in for a hung
             # device dispatch) until the watchdog aborts it — see chaos.py
             ch.on_decode_step(self)
-        t0 = time.monotonic()
         self._prefill_streak = 0
+        prev = self._inflight
+        if prev is not None and not self._carry_valid():
+            # Slot lifecycle changed under the in-flight dispatch (activate/
+            # preempt): its device carry no longer describes the batch, and
+            # the host mirrors are stale until its tokens land — fetch
+            # FIRST, then dispatch from the refreshed mirrors.
+            self._drain_decode_pipeline()
+            prev = None
         active = self._active_slots()
         # Fused horizon unless a waiting prompt could actually prefill next
         # step (pending AND a free slot): then take a single step so TTFT
@@ -1568,9 +1655,23 @@ class EnginePrograms:
             # pool runs dry — recompute the active set afterwards.
             grow = max(horizon, (self.serving.spec_k + 1)
                        if self.serving.spec_decode else 1)
+            if prev is not None:
+                # the unfetched dispatch writes its own horizon of rows
+                # before the one about to be enqueued
+                grow += prev["horizon"]
             if not self._ensure_pages(grow):
                 return
             active = self._active_slots()
+            if prev is not None and not self._carry_valid():
+                # _ensure_pages preempted under the in-flight dispatch
+                self._drain_decode_pipeline()
+                prev = None
+                active = self._active_slots()
+        if not active:
+            # cancel/deadline reaps emptied the batch since the last
+            # dispatch; nothing to decode — just settle the pipeline
+            self._drain_decode_pipeline()
+            return
         # Speculative path: only when nothing is waiting (prefill priority
         # stands) and the mesh is spec-safe (None or pure-tp — see
         # _spec_mesh_ok). Eligibility is PER SLOT: a logprobs, penalized, or
@@ -1622,47 +1723,135 @@ class EnginePrograms:
         want_pen = self.counts is not None and bool(
             self.pres_pens.any() or self.freq_pens.any()
             or (self.rep_pens != 1.0).any())
+        if prev is not None:
+            # device-resident carry: dispatch N's final token/length arrays
+            # feed dispatch N+1 directly (donated) — no host round-trip
+            tok_in, len_in = self._pipe_carry[0], self._pipe_carry[1]
+        else:
+            tok_in = jnp.asarray(self.last_token)
+            len_in = jnp.asarray(self.lengths)
+        rec = self._decode_dispatch(horizon, active, gset, gslots, want_lp,
+                                    want_pen, tok_in, len_in)
+        if self._pipeline_on() and not gset:
+            # leave the new dispatch in flight: its fetch is deferred to
+            # the next decode step (or a pipeline drain), so the entire
+            # emit/SSE/scheduling gap between dispatches overlaps device
+            # compute instead of idling the chip for ~an RTT
+            self._inflight = rec
+            self.metrics.pipeline_depth.set(1.0)
+            if prev is not None:
+                self._decode_fetch(prev, tail=False)
+        else:
+            # synchronous path (decode_pipeline=0, guided, chunk, spec,
+            # draining): settle everything before returning, in order. prev
+            # IS self._inflight — retire it before fetching, or the next
+            # step would fetch-and-emit the same dispatch twice (the
+            # double emit advances the length mirrors two rows per real
+            # token and exhausts the cache window at half budget).
+            self._pipe_carry = None
+            if prev is not None:
+                self._inflight = None
+                self.metrics.pipeline_depth.set(0.0)
+                self._decode_fetch(prev, tail=False)
+            self._decode_fetch(rec, tail=True)
+
+    def _decode_dispatch(self, horizon: int, active: List[int], gset,
+                         gslots: List[int], want_lp: bool, want_pen: bool,
+                         tok_in, len_in) -> dict:
+        """Enqueue ONE fused decode dispatch and return its in-flight
+        record. JAX async dispatch: this returns as soon as the program is
+        enqueued — no blocking device reads on this half (tpulint R8; they
+        belong in _decode_fetch), so the host is free to emit the previous
+        dispatch's tokens while the device runs this one."""
+        oc = self._decode_operands()
+        t0 = time.monotonic()
+        if self._last_ready > 0.0:
+            # the device has sat idle since the previous fetch completed
+            # with nothing enqueued behind it; the gap until THIS enqueue
+            # is pure host-side bubble — the cost the one-deep pipeline
+            # exists to hide (and the sync path pays every dispatch)
+            self.metrics.decode_bubble_seconds.inc(
+                max(0.0, t0 - self._last_ready))
+            self._last_ready = 0.0
         real_counts = self.counts
-        self.cache, new_counts, out = decode_steps(
-            self.cfg, horizon, self.params, self.cache,
-            jnp.asarray(self.last_token), jnp.asarray(self.lengths),
-            self._next_rng(), jnp.asarray(self.temps),
-            jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
+        self.cache, new_counts, out, tok, lens = decode_steps(
+            self.cfg, horizon, self.params, self.cache, tok_in, len_in,
+            self._next_rng(), oc["temps"], oc["top_ks"], oc["top_ps"],
             mesh=self.mesh, impl=self.serving.attention_impl,
             logprobs=want_lp,
             counts=self.counts if want_pen else None,
-            presence=jnp.asarray(self.pres_pens) if want_pen else None,
-            frequency=jnp.asarray(self.freq_pens) if want_pen else None,
-            repetition=jnp.asarray(self.rep_pens) if want_pen else None,
+            presence=oc["pres"] if want_pen else None,
+            frequency=oc["freq"] if want_pen else None,
+            repetition=oc["rep"] if want_pen else None,
             prompt_mask=self.prompt_mask if want_pen else None,
             penalties=want_pen,
-            table=jnp.asarray(self.table) if self.paged else None,
-            seeds=jnp.asarray(self.seeds),
-            ban_ids=jnp.asarray(self.ban_ids),
-            ban_until=jnp.asarray(self.ban_until),
-            bias_ids=jnp.asarray(self.bias_ids),
-            bias_vals=jnp.asarray(self.bias_vals),
+            table=oc["table"] if self.paged else None,
+            seeds=oc["seeds"],
+            ban_ids=oc["ban_ids"],
+            ban_until=oc["ban_until"],
+            bias_ids=oc["bias_ids"],
+            bias_vals=oc["bias_vals"],
             allow=self._allow_words(gslots),
-            lora_idx=self._lora_vec(),
+            lora_idx=oc["lora"],
             bblock=self.decode_bblock)
         # un-penalized dispatches return a dummy counts array — keep ours
         self.counts = new_counts if want_pen else real_counts
+        self._pipe_carry = (tok, lens, self._carry_gen)
+        return {"out": out, "horizon": horizon, "active": list(active),
+                "gset": gset, "gslots": gslots, "want_lp": want_lp,
+                "want_pen": want_pen, "t0": t0}
+
+    def _decode_fetch(self, rec: dict, tail: bool) -> None:
+        """Blocking half of a decode dispatch: transfer the sampled tokens,
+        update the host mirrors, emit. The ONLY place the decode path may
+        block on program output (tpulint R8 sanctions exactly this helper).
+
+        ``tail``: nothing is enqueued behind this dispatch, so the device
+        goes idle when it completes — mark the completion time and let the
+        next enqueue account the gap as host bubble. A non-tail fetch (the
+        steady-state pipelined case) already has the next dispatch queued:
+        no mark, no bubble.
+
+        A slot that finished (EOS/deadline/cancel) after this dispatch was
+        enqueued was still computed speculatively on the device; its
+        surplus tokens are discarded here by the ``slot_req is None``
+        guard, under the same rewrite invariant the guided/chunk surplus
+        paths rely on.
+        """
+        ch = _chaos.get()
+        if ch.enabled:
+            # an armed "pipeline_fetch_error" raises here, standing in for
+            # a transfer/XLA failure surfacing at the deferred block point
+            ch.on_pipeline_fetch(self)
+        out = rec["out"]
         lp_t = None
-        if want_lp:
+        if rec["want_lp"]:
             out, lp_t = out          # ([h, B], ([h,B], [h,B,K], [h,B,K]))
             # ONE bulk transfer; per-token slicing below is pure numpy (3
             # tiny device gathers per emitted token would round-trip the
             # network-attached chip thousands of times per dispatch)
             lp_t = tuple(np.asarray(a) for a in lp_t)
-        out = np.asarray(out)  # [horizon, B]
-        dt = time.monotonic() - t0
-        self.metrics.decode_step_duration.observe(dt / horizon)
-        self.metrics.device_busy_seconds.inc(dt)
+        out = np.asarray(out)  # [horizon, B] — blocks until device-complete
+        t_ready = time.monotonic()
+        horizon = rec["horizon"]
+        # Device-time attribution: the busy window opens at this dispatch's
+        # enqueue or the previous dispatch's completion, whichever is later
+        # — overlapped dispatches must not double-count device seconds, and
+        # decode_step_duration reports device time now that wall time
+        # includes pipeline overlap.
+        busy_start = max(rec["t0"], self._busy_watermark)
+        dev_dt = max(0.0, t_ready - busy_start)
+        self._busy_watermark = t_ready
+        self.metrics.device_busy_seconds.inc(dev_dt)
+        self.metrics.decode_step_duration.observe(dev_dt / horizon)
+        gset = rec["gset"]
         emitted = 0
         for s in range(horizon):
-            for slot in active:
+            for slot in rec["active"]:
                 if self.slot_req[slot] is None:
-                    continue  # finished earlier in this horizon
+                    # finished earlier in this horizon — or after the
+                    # dispatch was enqueued (pipelined surplus discard)
+                    continue
                 if s > 0 and slot in gset:
                     # guided slots advance one grammar-checked token per
                     # dispatch; substeps past 0 are unconstrained surplus
@@ -1676,14 +1865,14 @@ class EnginePrograms:
                 self.sched.note_decode(slot, 1)
                 self._emit(slot, int(out[s, slot]), lp)
                 emitted += 1
-        if want_pen and gslots and horizon > 1:
+        if rec["want_pen"] and rec["gslots"] and horizon > 1:
             # the fused dispatch incremented guided slots' device-side
             # penalty-count rows for EVERY substep, but only substep 0 was
             # emitted — resync those rows from the authoritative host
             # stream (review r5: the first fix dropped the whole batch to
             # horizon 1 for one penalized guided request; this one costs a
             # single [V]-row scatter per guided slot instead)
-            for slot in gslots:
+            for slot in rec["gslots"]:
                 req = self.slot_req[slot]
                 if req is None or not (self.pres_pens[slot]
                                        or self.freq_pens[slot]
@@ -1694,7 +1883,9 @@ class EnginePrograms:
                 self.counts = _restore_count_row(
                     self.counts, jnp.int32(slot),
                     jnp.asarray(row, jnp.int32))
-        self._tok_times.append((t0, emitted))
+        if tail and any(r is not None for r in self.slot_req):
+            self._last_ready = t_ready
+        self._tok_times.append((rec["t0"], emitted))
         if len(self._tok_times) >= 2:
             span = time.monotonic() - self._tok_times[0][0]
             toks = sum(n for _, n in self._tok_times)
@@ -1808,7 +1999,7 @@ class EnginePrograms:
                 self.submit(r)
             drain()
             if horizon > 1:
-                self.cache, _, _ = decode_steps(
+                self.cache, _, _, _, _ = decode_steps(
                     self.cfg, horizon, self.params, self.cache,
                     jnp.asarray(self.last_token), jnp.asarray(self.lengths),
                     self._next_rng(), jnp.asarray(self.temps),
@@ -1894,7 +2085,7 @@ class EnginePrograms:
         cnts = jnp.zeros((self.num_slots, self.cfg.vocab_size), jnp.int32)
         cnts = _reset_count_row(cnts, jnp.int32(0), jnp.int32(0))
         mask = jnp.zeros((self.num_slots, self.cfg.vocab_size), jnp.bool_)
-        self.cache, _, _ = decode_steps(
+        self.cache, _, _, _, _ = decode_steps(
             self.cfg, horizon, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
             self._next_rng(), jnp.asarray(self.temps),
@@ -1937,7 +2128,7 @@ class EnginePrograms:
         # doesn't stall all in-flight streams on XLA. Direct call, no slot
         # state touched: writes land at position 0 of idle slots and are
         # overwritten by real prefills.
-        self.cache, _, _ = decode_steps(
+        self.cache, _, _, _, _ = decode_steps(
             self.cfg, 1, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lengths),
             self._next_rng(), jnp.asarray(self.temps),

@@ -48,6 +48,15 @@ def _now() -> int:
     return int(tracing.wall_clock())
 
 
+def _bubble_pct(eng) -> Optional[float]:
+    """Host-bubble share of the decode timeline: bubble / (bubble + busy)."""
+    bubble = eng.metrics.decode_bubble_seconds.total()
+    busy = eng.metrics.device_busy_seconds.total()
+    if bubble + busy <= 0:
+        return None
+    return round(100.0 * bubble / (bubble + busy), 2)
+
+
 class _NotifyQueue(queue.Queue):
     """Request out_queue that signals a shared Event on every put.
 
@@ -341,6 +350,11 @@ class Handler(BaseHTTPRequestHandler):
                 # the autotuned decode batch-block (ISSUE r6): operators can
                 # confirm the served kernel config without scraping metrics
                 "decode_bblock": getattr(eng, "decode_bblock", None),
+                # decode pipeline (r9): knob state plus the host-bubble share
+                # of device wall time — sync mode shows the real gap the
+                # pipeline would hide; pipelined steady state trends to 0.
+                "decode_pipeline": eng.serving.decode_pipeline,
+                "decode_bubble_pct": _bubble_pct(eng),
                 "weights_dtype": eng.serving.weights_dtype,
                 "kv_dtype": eng.serving.kv_dtype,
                 "paged": bool(getattr(eng, "paged", False)),
@@ -1575,6 +1589,12 @@ def main(argv=None):
     p.add_argument("--decode-bblock", type=int, default=0,
                    help="decode kernel batch-block (slots per grid step); "
                         "0 = autotune over {1,4,8} at startup (TPU only)")
+    p.add_argument("--decode-pipeline", type=int, default=1,
+                   help="one-deep asynchronous decode pipeline: dispatch "
+                        "N+1 is enqueued before N's tokens are fetched, "
+                        "hiding host emit/SSE time behind device compute "
+                        "(seeded streams stay byte-identical). 0 restores "
+                        "the synchronous dispatch-fetch-emit loop")
     p.add_argument("--chat-template", default="",
                    help="path to a Jinja chat template file")
     p.add_argument("--platform", default="",
@@ -1682,6 +1702,7 @@ def main(argv=None):
         max_cache_len=args.max_cache_len, dtype=args.dtype,
         kv_dtype=args.kv_dtype, weights_dtype=args.weights_dtype,
         decode_bblock=args.decode_bblock,
+        decode_pipeline=args.decode_pipeline,
         checkpoint_dir=args.checkpoint_dir, chat_template=args.chat_template,
         prefill_chunk=args.prefill_chunk,
         prefix_cache=not args.no_prefix_cache,

@@ -503,6 +503,11 @@ def measure() -> None:
         # {1, 4, 8} (TPU only; exactly what a production pod runs), a
         # positive value pins it for the sweep's bblock axis.
         decode_bblock=int(env("TPU_BENCH_BBLOCK", "0")),
+        # One-deep async decode pipeline (r9): the shipped default. The
+        # sweep's TPU_BENCH_PIPELINE=0 axis measures the synchronous loop —
+        # on a network-attached chip the per-dispatch host bubble it pays is
+        # the ~RTT-sized term the pipeline exists to hide.
+        decode_pipeline=int(env("TPU_BENCH_PIPELINE", "1")),
         # the tiny dry model runs f32 on CPU (parity with the test substrate)
         dtype="float32" if dry else "bfloat16",
     )
@@ -580,6 +585,7 @@ def measure() -> None:
             "kv_dtype": serving.kv_dtype,
             "weights_dtype": serving.weights_dtype,
             "paged": serving.paged,
+            "decode_pipeline": serving.decode_pipeline,
             "bblock": bb,
             "dma_steps_per_substep": int(dma_steps),
             "prefill_batch": serving.max_prefill_batch,
@@ -780,6 +786,107 @@ def coldstart() -> None:
         f.write("\n")
 
 
+def pipeline() -> None:
+    """Sync-vs-pipelined decode A/B on the CPU tiny model.
+
+    Two engines in one process (the second reuses the first's jitted
+    programs), identical seeded load, decode_pipeline=0 then 1. Reads the
+    engine's own split metrics: tok/s, accumulated host-bubble seconds
+    (tpu_serve_decode_bubble_seconds_total — the device-idle gap between a
+    fetch completing and the next dispatch) and device-busy seconds. The
+    pipelined pass must match-or-beat sync tok/s with LESS bubble — that
+    delta is the host emit/SSE/scheduling time the one-deep pipeline hides
+    behind device compute. Writes BENCH_pipeline_r01.json. On CPU the
+    "device" is the XLA host threadpool, so the overlap is real but the
+    per-dispatch gap is Python-emit-sized; on a network-attached TPU the
+    sync loop additionally pays ~one dispatch RTT per step (see
+    BENCH.json's dispatch_rtt_ms ≈ 89.5 ms), which is the production-sized
+    version of the same bubble.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+    import jax.numpy as jnp
+
+    from aws_k8s_ansible_provisioner_tpu.config import (ServingConfig,
+                                                        tiny_qwen3)
+    from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+    from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
+
+    steps = int(os.environ.get("TPU_BENCH_PIPELINE_STEPS", "80"))
+    batch = int(os.environ.get("TPU_BENCH_PIPELINE_BATCH", "8"))
+    horizon = 4
+
+    def run(decode_pipeline: int) -> dict:
+        cfg = tiny_qwen3()
+        serving = ServingConfig(
+            model="tiny-qwen3", max_decode_slots=batch,
+            max_cache_len=16 + (steps + 8) * horizon,
+            prefill_buckets=(32,), decode_horizon=horizon,
+            decode_pipeline=decode_pipeline, dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+        engine = Engine(cfg, params, serving)
+        engine.warmup(scope="bench")
+        for i in range(batch):
+            engine.submit(Request(
+                prompt_ids=[(11 * i + 5) % (cfg.vocab_size - 20) + 10] * 16,
+                max_tokens=serving.max_cache_len - 20, ignore_eos=True))
+        while engine.pending:
+            engine.step()
+        for _ in range(5):
+            engine.step()           # warm the decode path / fill the pipe
+        m = engine.metrics
+        toks0 = m.generated_tokens.total()
+        bub0 = m.decode_bubble_seconds.total()
+        dev0 = m.device_busy_seconds.total()
+        t0 = time.monotonic()
+        for _ in range(steps):
+            engine.step()
+        if engine._inflight is not None:
+            # count the trailing in-flight dispatch inside the timed window
+            # — the pipelined pass must not get a free unfetched dispatch
+            engine._drain_decode_pipeline()
+        dt = time.monotonic() - t0
+        return {
+            "toks_per_s": (m.generated_tokens.total() - toks0) / dt,
+            "bubble_s": m.decode_bubble_seconds.total() - bub0,
+            "device_s": m.device_busy_seconds.total() - dev0,
+            "wall_s": dt,
+        }
+
+    sync, pipe = run(0), run(1)
+    out = {
+        "bench": "pipeline", "rev": "r01",
+        "model": "tiny-qwen3", "platform": jax.devices()[0].platform,
+        "batch": batch, "decode_horizon": horizon, "timed_steps": steps,
+        "sync_toks_per_s": round(sync["toks_per_s"], 1),
+        "pipe_toks_per_s": round(pipe["toks_per_s"], 1),
+        "speedup": round(pipe["toks_per_s"] / max(1e-9, sync["toks_per_s"]),
+                         3),
+        "sync_bubble_s": round(sync["bubble_s"], 4),
+        "pipe_bubble_s": round(pipe["bubble_s"], 4),
+        "bubble_reduction_pct": round(
+            100.0 * (1.0 - pipe["bubble_s"] / max(1e-9, sync["bubble_s"])),
+            1),
+        "sync_device_s": round(sync["device_s"], 4),
+        "pipe_device_s": round(pipe["device_s"], 4),
+        # sync-mode host gap per dispatch: what each dispatch would pay
+        # again on top of RTT over a network-attached link
+        "sync_bubble_ms_per_step": round(1e3 * sync["bubble_s"] / steps, 3),
+    }
+    print(json.dumps(out), flush=True)
+    if not (pipe["toks_per_s"] >= sync["toks_per_s"]
+            and pipe["bubble_s"] < sync["bubble_s"]):
+        raise SystemExit(f"pipeline bench: pipelined pass did not beat sync "
+                         f"({out})")
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_pipeline_r01.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+
+
 if __name__ == "__main__":
     if "--measure" in sys.argv:
         measure()
@@ -787,6 +894,8 @@ if __name__ == "__main__":
         _coldstart_child()
     elif "--coldstart" in sys.argv:
         coldstart()
+    elif "--pipeline" in sys.argv:
+        pipeline()
     elif "--dry" in sys.argv:
         # Seconds-class CPU pass over the tiny model, in-process: proves the
         # whole field plumbing (bblock, weights_dtype, dma_steps_per_substep,

@@ -42,6 +42,11 @@ DEFAULT_GRID = {
     # measures each candidate the autotuner would choose between.
     "TPU_BENCH_BBLOCK": ["1", "4", "8"],
     "TPU_BENCH_WEIGHTS": ["int8", "bf16"],
+    # 3) one-deep decode pipeline A/B (r9): on the network-attached bench
+    #    chip the sync loop pays ~one dispatch RTT of host bubble per step;
+    #    the 0-axis measures that gap for real (bench.py --pipeline is the
+    #    chip-free CPU proof of the same machinery).
+    "TPU_BENCH_PIPELINE": ["1", "0"],
 }
 
 # --ttft: the prefill-lever grid (VERDICT r5 weak #3 — the 2,408 ms cold-

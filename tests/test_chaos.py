@@ -280,13 +280,17 @@ def test_estimated_wait_shed():
 def test_http_429_with_retry_after(http_server):
     """HTTP surface of load shedding: 429 + Retry-After + shed counters on
     /healthz."""
-    state, port = http_server(max_decode_slots=1, max_queue_depth=1)
+    # horizon-1 dispatches keep the hog stream busy for its whole budget —
+    # the queue must still be full when the shed POST lands (the pipelined
+    # decode path finishes a horizon-8 stream fast enough to race it)
+    state, port = http_server(max_decode_slots=1, max_queue_depth=1,
+                              decode_horizon=1)
     eng = state.engine
     done = {}
 
     def hog():
         try:
-            done["hog"] = _post(port, {"prompt": "hog", "max_tokens": 60,
+            done["hog"] = _post(port, {"prompt": "hog", "max_tokens": 120,
                                        "ignore_eos": True})
         except Exception as e:       # noqa: BLE001 — recorded for the assert
             done["hog"] = e

@@ -1,0 +1,420 @@
+"""One-deep asynchronous decode pipeline (serving/programs.py): dispatch N+1
+is enqueued before dispatch N's tokens are fetched, so the host gap hides
+behind device execution. These tests pin the correctness contract:
+
+- seeded streams are BYTE-IDENTICAL pipeline on vs off (sampled, logprobs,
+  penalties, guided, logit_bias) — per-(seed, position) keys make the token
+  stream a pure function of position, not of dispatch boundaries;
+- lifecycle edges drain or discard correctly: mid-stream cancel discards the
+  surplus tokens of the in-flight dispatch, deadlines reap at most one
+  dispatch late, chunked prefill admission drains the pipeline first,
+  graceful drain finishes in-flight streams;
+- the injected ``pipeline_fetch_error`` chaos fault discards the in-flight
+  dispatch, fails requests with "error", releases slots/pages exactly once,
+  and the engine keeps serving (chaos.py docstring contract);
+- the new metrics (tpu_serve_decode_bubble_seconds_total,
+  tpu_serve_pipeline_depth) register, move, and render on /metrics, and
+  /healthz reports the knob plus the bubble percentage.
+
+`make pipeline-smoke` runs this file LockSan-instrumented (TPU_LOCKSAN=1);
+tier-1 runs it bare via the ``pipeline_smoke`` marker.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
+from aws_k8s_ansible_provisioner_tpu.serving.engine import (
+    Engine, EngineOverloaded, Request)
+from aws_k8s_ansible_provisioner_tpu.serving.guided import grammar_for
+from aws_k8s_ansible_provisioner_tpu.serving.server import build_state, serve
+from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+pytestmark = pytest.mark.pipeline_smoke
+
+MODEL = "tiny-qwen3"
+_PORTS = iter(range(18500, 18560))
+
+SEEDED = dict(prompt_ids=[5, 9, 2], max_tokens=10, temperature=0.9,
+              ignore_eos=True, seed=42)
+
+# completion pressure for the guided test (same rationale as test_guided):
+# bias a random-weight model toward closing its JSON inside the budget.
+_EOS = ByteTokenizer.EOS
+_PRESSURE = ((ord(' '), -50.0), (ord('\t'), -50.0), (ord('\n'), -50.0),
+             (ord('\r'), -50.0), (ord('['), -20.0),
+             (ord('\\'), -100.0), (ord('"'), 30.0), (ord('}'), 20.0),
+             (ord(']'), 15.0), (ord(':'), 20.0), (ord(','), 5.0),
+             (_EOS, 100.0))
+
+
+@pytest.fixture(autouse=True)
+def fresh_chaos():
+    _chaos.reset()
+    yield
+    _chaos.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    return tok, cfg, params
+
+
+def _engine(model, **over):
+    tok, cfg, params = model
+    base = dict(weights_dtype="bf16", model=MODEL, max_decode_slots=2,
+                max_cache_len=128, page_size=32,
+                prefill_buckets=(16, 32, 64, 128), dtype="float32",
+                derived_seed=0)
+    base.update(over)
+    return Engine(cfg, params, ServingConfig(**base))
+
+
+def _drain(eng, limit=20000):
+    for _ in range(limit):
+        if not eng.step():
+            return
+    raise AssertionError("engine failed to quiesce")
+
+
+def _settled(eng, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        st = eng.sched.stats()
+        if st.active_slots == 0 and st.queue_depth == 0 \
+                and not eng.pending and eng._chunk is None:
+            return st
+        time.sleep(0.05)
+    raise AssertionError(f"engine never settled: {eng.sched.stats()}")
+
+
+def _assert_released(eng, n_terminal=None):
+    st = _settled(eng)
+    assert st.active_slots == 0, st
+    if eng.paged:
+        for a in eng.allocators:
+            assert a.stats()["pages_live"] == 0, a.stats()
+    if n_terminal is not None:
+        assert st.finished_total + st.cancelled_total == n_terminal, st
+    # the pipeline itself must be fully retired too (a run_forever thread
+    # drains the surplus dispatch on its step AFTER the last emit — allow it
+    # one scheduling quantum)
+    deadline = time.monotonic() + 10.0
+    while eng._inflight is not None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert eng._inflight is None
+    assert eng.metrics.pipeline_depth.value() == 0.0
+    return st
+
+
+def _run_set(eng, specs):
+    """Submit every request spec, run to quiescence, return the requests."""
+    reqs = [eng.submit(Request(**s)) for s in specs]
+    _drain(eng)
+    return reqs
+
+
+def _stream_bytes(req):
+    """Everything a client could observe from this request, as one tuple."""
+    lp = None
+    if req.logprob_data is not None:
+        lp = tuple((own, tuple(alts)) for own, alts in req.logprob_data)
+    return (tuple(req.generated), req.finish_reason, lp)
+
+
+# -- byte-identity: pipeline on vs off ---------------------------------------
+
+
+def test_seeded_streams_byte_identical_pipeline_on_off(model):
+    """The golden contract: the one-deep pipeline changes WHEN tokens reach
+    the host, never WHICH tokens — sampled, logprobs, penalties, bias."""
+    specs = [
+        dict(SEEDED),
+        dict(prompt_ids=[7, 7, 3], max_tokens=12, temperature=0.8, seed=11,
+             ignore_eos=True, logprobs=3),
+        dict(prompt_ids=[4, 8, 15, 16], max_tokens=12, temperature=0.7,
+             seed=99, ignore_eos=True, presence_penalty=0.6,
+             frequency_penalty=0.4, repetition_penalty=1.2),
+        dict(prompt_ids=[23, 42], max_tokens=8, temperature=0.0,
+             ignore_eos=True, logit_bias=((5, 4.0), (9, -100.0))),
+    ]
+    pipelined = _run_set(_engine(model, decode_pipeline=1), list(specs))
+    sync = _run_set(_engine(model, decode_pipeline=0), list(specs))
+    for p, s in zip(pipelined, sync):
+        assert _stream_bytes(p) == _stream_bytes(s), \
+            "pipelined stream must be byte-identical to the sync stream"
+    assert all(r.finish_reason == "length" for r in pipelined)
+
+
+def test_guided_request_and_neighbor_identical_pipeline_on_off(model):
+    """Guided slots force per-dispatch sync decode; the pipeline must hand
+    over cleanly AND leave the unguided neighbor's seeded stream intact."""
+    tok, _, _ = model
+
+    def run(pipeline):
+        eng = _engine(model, decode_pipeline=pipeline)
+        g = grammar_for(tok, {"type": "json_object"}, [tok.eos_token_id])
+        guided = eng.generate(tok.encode("json:"), guided=g, max_tokens=100,
+                              temperature=0.0, logit_bias=_PRESSURE)
+        neighbor = eng.submit(Request(**SEEDED))
+        _drain(eng)
+        return eng, guided, neighbor
+
+    eng1, g1, n1 = run(1)
+    eng0, g0, n0 = run(0)
+    assert g1.finish_reason == "stop"
+    assert isinstance(json.loads(tok.decode(g1.generated)), dict)
+    assert _stream_bytes(g1) == _stream_bytes(g0)
+    assert _stream_bytes(n1) == _stream_bytes(n0)
+    _assert_released(eng1)
+    _assert_released(eng0)
+
+
+def test_chunked_prefill_admission_drains_pipeline_first(model):
+    """A long prompt that needs chunked prefill arrives mid-decode: the
+    engine must drain the in-flight dispatch before starting the chunk
+    (the chunk rewrites cache pages the dispatch could still be reading's
+    host mirrors of) — and the streams still match the sync engine."""
+    long_prompt = [(i % 200) + 5 for i in range(120)]
+
+    def run(pipeline):
+        eng = _engine(model, decode_pipeline=pipeline, prefill_chunk=32,
+                      max_cache_len=256)
+        first = eng.submit(Request(**SEEDED, ))
+        # get the first stream decoding (and, pipelined, an in-flight
+        # dispatch) before the chunked prompt shows up
+        for _ in range(6):
+            eng.step()
+        late = eng.submit(Request(prompt_ids=long_prompt, max_tokens=8,
+                                  temperature=0.9, seed=7, ignore_eos=True))
+        _drain(eng)
+        return eng, first, late
+
+    eng1, f1, l1 = run(1)
+    eng0, f0, l0 = run(0)
+    assert _stream_bytes(f1) == _stream_bytes(f0)
+    assert _stream_bytes(l1) == _stream_bytes(l0)
+    assert l1.finish_reason == "length" and len(l1.generated) >= 6
+    _assert_released(eng1)
+
+
+# -- lifecycle edges ---------------------------------------------------------
+
+
+def test_mid_stream_cancel_discards_surplus_neighbor_unperturbed(model):
+    """Cancel one stream mid-flight: its slot's surplus tokens from the
+    in-flight dispatch are discarded (never emitted), release happens
+    exactly once, and the surviving seeded neighbor's bytes are identical
+    to a solo run."""
+    solo = _engine(model, decode_pipeline=1)
+    r_solo = solo.submit(Request(**SEEDED))
+    _drain(solo)
+
+    eng = _engine(model, decode_pipeline=1)
+    victim = eng.submit(Request(prompt_ids=[9] * 4, max_tokens=64,
+                                temperature=1.1, ignore_eos=True))
+    keeper = eng.submit(Request(**SEEDED))
+    # run until the victim is visibly mid-stream (pipeline in flight)
+    for _ in range(1000):
+        eng.step()
+        if len(victim.generated) >= 4:
+            break
+    assert len(victim.generated) >= 4
+    n_at_cancel = len(victim.generated)
+    eng.cancel(victim)
+    _drain(eng)
+    assert victim.finish_reason == "cancelled"
+    # surplus discard: at most the already-fetched prefix plus the one
+    # dispatch that was in flight at cancel time may land, never more
+    assert len(victim.generated) <= n_at_cancel + 2 * eng.serving.decode_horizon
+    assert keeper.generated == r_solo.generated, \
+        "a neighbor's cancel must not perturb a seeded stream"
+    _assert_released(eng)
+
+
+def test_deadline_reaps_at_most_one_dispatch_late(model):
+    """Deadlines are enforced between dispatches; with the pipeline the
+    expiry check can land one dispatch later — bounded, and the slot/pages
+    still release exactly once with finish_reason 'timeout'."""
+    # a sequence budget large enough that the stream CANNOT finish by length
+    # inside the deadline on CPU (tiny_qwen3's default max_seq_len=128 caps
+    # the budget at ~124 tokens, which decodes in milliseconds here)
+    tok, _, _ = model
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size,
+                     eos_token_id=tok.eos_token_id, max_seq_len=4096)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    eng = Engine(cfg, params, ServingConfig(
+        weights_dtype="bf16", model=MODEL, max_decode_slots=2,
+        max_cache_len=4096, page_size=32, prefill_buckets=(16, 32),
+        dtype="float32", derived_seed=0, decode_pipeline=1))
+    t0 = time.monotonic()
+    req = eng.submit(Request(prompt_ids=[3, 1, 4], max_tokens=100000,
+                             temperature=0.9, ignore_eos=True,
+                             deadline_s=0.25))
+    _drain(eng)
+    assert req.finish_reason == "timeout"
+    # reap latency is bounded by roughly one extra dispatch, not unbounded
+    assert time.monotonic() - t0 < 30.0
+    assert eng.metrics.deadline_expired.total() >= 1
+    _assert_released(eng, 1)
+
+
+def test_graceful_drain_finishes_inflight_pipeline(model):
+    """begin_drain with a dispatch in flight: streams finish normally,
+    admissions shed with 'draining', the pipeline retires, and the
+    draining→sync handover emits each in-flight token EXACTLY once — the
+    drained streams are byte-identical to an undisturbed run (a re-fetch
+    of the in-flight dispatch would duplicate tokens and double-advance
+    the length mirrors)."""
+    ref = _engine(model, decode_pipeline=1)
+    ref_reqs = [ref.submit(Request(prompt_ids=[5 + i] * 4, max_tokens=16,
+                                   temperature=0.9, seed=i, ignore_eos=True))
+                for i in range(2)]
+    _drain(ref)
+
+    eng = _engine(model, decode_pipeline=1)
+    stop = threading.Event()
+    t = threading.Thread(target=eng.run_forever, args=(stop,), daemon=True)
+    t.start()
+    try:
+        reqs = [eng.generate([5 + i] * 4, max_tokens=16, temperature=0.9,
+                             seed=i, ignore_eos=True) for i in range(2)]
+        # wait until both streams are actually decoding
+        deadline = time.monotonic() + 20
+        while (not all(len(r.generated) >= 2 for r in reqs)
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        eng.begin_drain(timeout_s=30.0)
+        with pytest.raises(EngineOverloaded) as ei:
+            eng.submit(Request(prompt_ids=[1, 2], max_tokens=4))
+        assert ei.value.reason == "draining"
+        for r, ref_r in zip(reqs, ref_reqs):
+            assert r.wait(timeout=30.0)
+            assert r.finish_reason == "length"
+            assert r.generated == ref_r.generated, \
+                "drain handover must emit in-flight tokens exactly once"
+        _assert_released(eng)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+# -- chaos: injected fetch failure ------------------------------------------
+
+
+def test_pipeline_fetch_error_discards_inflight_and_recovers(model):
+    """chaos.py contract for ``pipeline_fetch_error``: the in-flight
+    dispatch is discarded un-emitted, affected requests fail with
+    finish_reason 'error', slots/pages release exactly once, and the
+    engine keeps serving the next request."""
+    _chaos.get().inject("pipeline_fetch_error", after=2, times=1)
+    eng = _engine(model, decode_pipeline=1)
+    stop = threading.Event()
+    t = threading.Thread(target=eng.run_forever, args=(stop,), daemon=True)
+    t.start()
+    try:
+        doomed = [eng.generate([7 + i] * 4, max_tokens=48, temperature=1.0,
+                               ignore_eos=True) for i in range(2)]
+        for r in doomed:
+            assert r.wait(timeout=30.0)
+            assert r.finish_reason == "error", r.finish_reason
+        # the in-flight dispatch was discarded, not emitted or leaked
+        assert eng._inflight is None
+        assert eng.metrics.pipeline_depth.value() == 0.0
+        # recovery: the same engine completes a fresh request normally
+        ok = eng.generate([2, 4, 6], max_tokens=6, temperature=0.0,
+                          ignore_eos=True)
+        assert ok.wait(timeout=30.0)
+        assert ok.finish_reason == "length"
+        assert len(ok.generated) == 6
+        _assert_released(eng)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+# -- metrics and observability ----------------------------------------------
+
+
+def test_pipeline_depth_gauge_and_bubble_accounting(model):
+    """pipeline_depth rides 0→1→0 across a pipelined run; the sync engine
+    accrues host-bubble seconds that the pipelined engine hides."""
+    pipe = _engine(model, decode_pipeline=1)
+    saw_depth_one = False
+    reqs = [pipe.submit(Request(prompt_ids=[3 + i] * 4, max_tokens=24,
+                                temperature=0.9, seed=i, ignore_eos=True))
+            for i in range(2)]
+    for _ in range(20000):
+        alive = pipe.step()
+        if pipe.metrics.pipeline_depth.value() == 1.0:
+            saw_depth_one = True
+        if not alive:
+            break
+    assert saw_depth_one, "pipelined decode never reached depth 1"
+    assert all(r.finish_reason == "length" for r in reqs)
+    _assert_released(pipe)
+
+    sync = _engine(model, decode_pipeline=0)
+    _run_set(sync, [dict(prompt_ids=[3 + i] * 4, max_tokens=24,
+                         temperature=0.9, seed=i, ignore_eos=True)
+                    for i in range(2)])
+    sync_bubble = sync.metrics.decode_bubble_seconds.total()
+    pipe_bubble = pipe.metrics.decode_bubble_seconds.total()
+    assert sync_bubble > 0.0, \
+        "sync decode must account a host bubble between dispatches"
+    assert pipe_bubble < sync_bubble, (pipe_bubble, sync_bubble)
+    # device-time accounting moved too (re-based decode_step_duration base)
+    assert sync.metrics.device_busy_seconds.total() > 0.0
+    assert pipe.metrics.device_busy_seconds.total() > 0.0
+
+
+def test_http_healthz_and_metrics_expose_pipeline(model):
+    """/healthz reports the knob and the bubble share; /metrics renders both
+    new series (R2: registered AND rendered)."""
+    tok, cfg, params = model
+    state = build_state(
+        ServingConfig(weights_dtype="bf16", model=MODEL, max_decode_slots=2,
+                      max_cache_len=128, page_size=32,
+                      prefill_buckets=(16, 32, 64, 128), dtype="float32",
+                      derived_seed=0, decode_pipeline=1),
+        model_cfg=cfg, params=params, tokenizer=tok)
+    port = next(_PORTS)
+    ready, stop = threading.Event(), threading.Event()
+    threading.Thread(target=serve,
+                     args=(state, "127.0.0.1", port, ready, stop),
+                     daemon=True).start()
+    assert ready.wait(10)
+    try:
+        body = json.dumps({"model": MODEL, "prompt": "hi", "max_tokens": 6,
+                           "ignore_eos": True}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["decode_pipeline"] == 1
+        assert "decode_bubble_pct" in health
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert "tpu_serve_decode_bubble_seconds_total" in text
+        assert "tpu_serve_pipeline_depth" in text
+    finally:
+        stop.set()
+        time.sleep(0.1)

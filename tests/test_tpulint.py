@@ -491,6 +491,76 @@ def test_r7_fires_when_target_module_missing(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# R8: no blocking device reads on the decode dispatch path
+# ---------------------------------------------------------------------------
+
+
+def test_r8_fires_on_blocking_reads_in_dispatch_path(tmp_path):
+    fs = _lint(tmp_path, {"pkg/serving/a.py": """
+        import numpy as np
+        import jax
+
+        class E:
+            def _do_decode(self):
+                out = self.dispatch()
+                toks = np.asarray(out)
+                return toks
+
+            def _decode_dispatch(self):
+                out = self.run()
+                out.block_until_ready()
+                host = jax.device_get(out)
+                return host
+    """}, only=["R8"])
+    assert _rules_of(fs) == ["R8", "R8", "R8"]
+    assert "np.asarray" in fs[0].message
+    assert "block_until_ready" in fs[1].message
+    assert "device_get" in fs[2].message
+    assert all("_decode_fetch" in f.message for f in fs)
+
+
+def test_r8_clean_in_fetch_helper_and_elsewhere(tmp_path):
+    fs = _lint(tmp_path, {"pkg/serving/a.py": """
+        import numpy as np
+        import jax
+
+        class E:
+            def _decode_fetch(self, rec):
+                # the one sanctioned block point
+                out = np.asarray(rec["out"])
+                jax.device_get(rec["lp"])
+                return out
+
+            def _do_decode(self):
+                rec = self._decode_dispatch()
+                self._decode_fetch(rec)
+
+            def _decode_dispatch(self):
+                # non-blocking device work is fine
+                return self.program(self.jnp_arrays)
+
+            def unrelated(self):
+                # blocking reads OUTSIDE the dispatch path are fine
+                return np.asarray(self.table)
+    """}, only=["R8"])
+    assert fs == []
+
+
+def test_r8_pragma_with_reason_suppresses(tmp_path):
+    fs = _lint(tmp_path, {"pkg/serving/a.py": """
+        import numpy as np
+
+        class E:
+            def _do_decode(self):
+                out = self.dispatch()
+                # tpulint: disable=R8 debug assert, stripped in prod builds
+                toks = np.asarray(out)
+                return toks
+    """}, only=["R8"])
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
 # runner semantics
 # ---------------------------------------------------------------------------
 

@@ -1,4 +1,4 @@
-"""tpulint rules R1-R7. Each rule is a pure function Project -> [Finding].
+"""tpulint rules R1-R8. Each rule is a pure function Project -> [Finding].
 
 These are PROJECT-NATIVE rules: they encode this repo's concurrency and
 observability contracts, not generic style. Where a rule is necessarily
@@ -701,3 +701,65 @@ def r7_manifest_flags(project: Project) -> List[Finding]:
         if text:
             out.extend(r7_check_template(project, rel, text))
     return out
+
+
+# ---------------------------------------------------------------------------
+# R8: no blocking device reads on the decode dispatch path
+# ---------------------------------------------------------------------------
+
+# The dispatch half of the decode pipeline must stay fire-and-forget: a
+# blocking read inside these functions serializes device and host again,
+# silently reintroducing the per-dispatch bubble the pipeline exists to
+# hide. The fetch helper is the one sanctioned block point.
+_R8_DISPATCH_FNS = {"_do_decode", "_decode_dispatch",
+                    "_drain_decode_pipeline", "_decode_operands"}
+_R8_SANCTIONED_FNS = {"_decode_fetch"}
+_R8_BLOCKING_ATTRS = {"block_until_ready", "device_get"}
+
+
+@rule("R8", "no blocking device reads on the decode dispatch path")
+def r8_decode_blocking(project: Project) -> List[Finding]:
+    """Inside the decode dispatch-path functions (``_do_decode``,
+    ``_decode_dispatch``, ``_drain_decode_pipeline``, ``_decode_operands``)
+    in serving/, any host-blocking device read — ``np.asarray(...)``,
+    ``jax.device_get(...)``, ``<x>.block_until_ready()`` — is a finding:
+    it re-serializes the one-deep pipeline and the bubble metric stops
+    measuring anything. The deferred block point is ``_decode_fetch`` and
+    only ``_decode_fetch``; code that must materialize there calls it. A
+    reasoned ``# tpulint: disable=R8`` pragma escapes the rule (e.g. a
+    debug assert)."""
+    out: List[Finding] = []
+    for f in project.serving_files():
+        for node, ancestors in _walk_with_stack(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            encl = _enclosing_funcdef(ancestors)
+            if encl is None or encl.name not in _R8_DISPATCH_FNS:
+                continue
+            if encl.name in _R8_SANCTIONED_FNS:
+                continue
+            fn = node.func
+            what = None
+            if (isinstance(fn, ast.Attribute) and fn.attr == "asarray"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "np"):
+                what = "np.asarray(...)"
+            elif (isinstance(fn, ast.Attribute)
+                    and fn.attr in _R8_BLOCKING_ATTRS):
+                chain = attr_chain(fn.value)
+                if fn.attr == "device_get":
+                    if chain == ["jax"]:
+                        what = "jax.device_get(...)"
+                else:
+                    what = f".{fn.attr}()"
+            if what is None:
+                continue
+            out.append(Finding(
+                "R8", f.rel, node.lineno,
+                f"blocking device read {what} inside '{encl.name}' — the "
+                "decode dispatch path must not synchronize with the device "
+                "(it re-serializes the pipeline); defer the read to the "
+                "sanctioned fetch helper _decode_fetch"))
+    return out
+
+
