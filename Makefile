@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests \
 	overload-smoke resume-smoke reconcile-smoke trace-smoke lint \
-	locksan-smoke aot-smoke pipeline-smoke
+	locksan-smoke aot-smoke pipeline-smoke flight-smoke
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -131,6 +131,16 @@ pipeline-smoke:
 #   python -m aws_k8s_ansible_provisioner_tpu.serving.aot --model Qwen/Qwen3-8B --tp 8
 aot-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m aot_smoke \
+		-p no:cacheprovider
+
+# Flight-recorder smoke (serving/flightrec.py + serving/slo.py): a chaos-
+# injected deadline expiry must yield a spooled black-box dump with the
+# complete admit -> deadline_reap -> finish timeline and trace ids via
+# /debug/flight/<id>; seeded streams stay byte-identical recorder on vs
+# off; an injected spool fault (flight_dump_error) is counted, never felt
+# by a request. Tier-1 runs the same tests (marker flight_smoke).
+flight-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m flight_smoke \
 		-p no:cacheprovider
 
 # Full bench field-plumbing proof on CPU (tiny model, ~15 s): one JSON line

@@ -740,6 +740,21 @@ class ServingConfig:
     # the caller's decision (W3C parent-based sampling), so the router's
     # knob effectively governs the whole tree.
     trace_sample: float = 1.0
+    # ---- SLO burn rates + flight recorder (serving/slo.py, flightrec.py) ----
+    # TTFT p95 objective in milliseconds: first tokens slower than this burn
+    # the 5% latency error budget. 0 disables the objective (the shipped
+    # default — a target only makes sense per deployment/model).
+    slo_ttft_p95_ms: float = 0.0
+    # Error-rate SLO budget: the allowed fraction of requests finishing
+    # error/timeout. Burn rate 1.0 = failing at exactly this rate; the
+    # Google-SRE 5m/1h windows export as tpu_serve_slo_burn_rate gauges and
+    # the L3 reconcile probe reads them off /healthz. 0 disables.
+    slo_error_rate: float = 0.01
+    # Flight-recorder anomaly spool: a directory for capped JSONL dumps of
+    # anomalous request timelines (deadline expiry, shed, watchdog failure).
+    # Empty = in-memory snapshots only (/debug/flight/<id> still serves the
+    # recent ones). serving.yaml.j2 backs it with the pod's emptyDir.
+    flight_spool_dir: str = ""
     # Seed for the engine's DERIVED sampling seeds (requests without an
     # OpenAI ``seed``). None = entropy from os.urandom at engine start, so
     # restarts and replicas draw independently (the vLLM/OpenAI
@@ -884,6 +899,12 @@ def ansible_vars(cfg: FrameworkConfig | None = None,
                           or f"http://tempo.{cfg.deploy.otel_namespace}"
                              ".svc.cluster.local:4318")
     d["serving_trace_sample"] = cfg.serving.trace_sample
+    # SLO objectives + flight recorder (this PR): the manifest threads these
+    # to --slo-ttft-p95-ms / --slo-error-rate / --flight-spool-dir.
+    d["serving_slo_ttft_p95_ms"] = cfg.serving.slo_ttft_p95_ms
+    d["serving_slo_error_rate"] = cfg.serving.slo_error_rate
+    d["serving_flight_spool_dir"] = (cfg.serving.flight_spool_dir
+                                     or "/tmp/tpu-serve-flight")
     # --set overrides (rehearsals pin model/ports); unknown keys pass
     # through — the playbooks treat group_vars as an open namespace
     d.update(overrides or {})

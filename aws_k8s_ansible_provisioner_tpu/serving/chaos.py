@@ -50,6 +50,11 @@ fault                       defined degradation behavior
                             requests succeed unchanged and the spans are
                             dropped and counted
                             (``tpu_serve_spans_dropped_total``)
+``flight_dump_error``       the flight-recorder spool write fails (disk
+                            full) or hangs (``mode``) — only the recorder's
+                            background writer thread sees it: requests
+                            succeed unchanged and the dump is dropped and
+                            counted (``tpu_serve_flight_drops_total``)
 ``deadline``                (engine-native, no injection needed) request
                             past its deadline is cancelled, slot/pages
                             released, client gets 408 deadline_exceeded
@@ -83,7 +88,8 @@ from typing import Dict, Optional
 
 FAULTS = ("connect_refused", "stalled_decode", "page_exhaustion",
           "slow_client", "mid_stream_disconnect", "kill_stream",
-          "stream_read_error", "span_export", "pipeline_fetch_error")
+          "stream_read_error", "span_export", "pipeline_fetch_error",
+          "flight_dump_error")
 
 
 class InjectedFault(RuntimeError):
@@ -172,7 +178,15 @@ class ChaosController:
             if s.times >= 0 and s.fired >= s.times:
                 return None
             s.fired += 1
-            return dict(s.params)
+            params = dict(s.params)
+        # Every fired fault lands in the flight-recorder ring (outside the
+        # chaos lock — the recorder takes its own; the deferred import
+        # breaks the chaos <- flightrec module cycle). Drop-on-overflow:
+        # recording can never block or fail the faulting path either.
+        from aws_k8s_ansible_provisioner_tpu.serving import flightrec
+
+        flightrec.record("chaos_fault", None, fault=fault)
+        return params
 
     def stats(self) -> dict:
         with self._lock:
@@ -318,6 +332,24 @@ class ChaosController:
             raise InjectedFault("chaos: trace collector answered 503")
         raise ConnectionRefusedError("chaos: trace collector refused "
                                      "connection")
+
+    def on_flight_dump(self) -> None:
+        """flightrec.FlightRecorder._write entry (spool writer background
+        thread ONLY — never a request thread): an armed ``flight_dump_error``
+        makes the spool write misbehave per ``mode``: ``oserror`` (default)
+        raises the OSError of a full disk; ``hang`` sleeps ``hang_s``
+        (default 2.0 — still on the writer thread, so request latency is
+        untouched) then raises. Both must resolve to a dropped-and-counted
+        dump (``tpu_serve_flight_drops_total{reason="dump_error"}``), never
+        a failed or stalled request — tests/test_flightrec.py asserts that
+        contract, the mirror of the span_export one."""
+        p = self.fire("flight_dump_error")
+        if p is None:
+            return
+        mode = str(p.get("mode", "oserror"))
+        if mode == "hang":
+            time.sleep(float(p.get("hang_s", 2.0)))
+        raise OSError("chaos: flight spool write failed (disk full)")
 
 
 _controller: Optional[ChaosController] = None

@@ -43,6 +43,8 @@ import numpy as np
 
 from aws_k8s_ansible_provisioner_tpu.config import ModelConfig, ServingConfig
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
+from aws_k8s_ansible_provisioner_tpu.serving import flightrec as _flight
+from aws_k8s_ansible_provisioner_tpu.serving import slo as _slo
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import EngineMetrics
 from aws_k8s_ansible_provisioner_tpu.serving.programs import (  # noqa: F401
     BAN_K,
@@ -714,6 +716,8 @@ class Engine(EnginePrograms):
             # bound-exempt: already-admitted work must never shed on requeue
             self.sched.requeue(req.id, len(ids), remaining)
         self.metrics.preemptions.inc()
+        _flight.record("preempt", req.id, slot=slot,
+                       n_generated=len(req.generated), front=front)
         self.metrics.active_requests.set(len(self._active_slots()))
         self.metrics.queue_depth.set(self.sched.stats().queue_depth)
 
@@ -724,6 +728,9 @@ class Engine(EnginePrograms):
         # Nothing was generated, so the caller (router) may always re-route.
         if self.draining:
             self.metrics.requests_shed.inc(reason="draining")
+            _slo.get().observe_admission(shed=True)
+            _flight.record("shed", req.id, reason="draining")
+            _flight.finish(req.id, "shed", ok=False)
             raise EngineOverloaded(
                 "draining", "engine is draining; not admitting new requests",
                 retry_after_s=max(1.0, self._drain_deadline
@@ -826,6 +833,10 @@ class Engine(EnginePrograms):
             est = self._estimated_wait_s(st)
             if est > mw:
                 self.metrics.requests_shed.inc(reason="est_wait")
+                _slo.get().observe_admission(shed=True)
+                _flight.record("shed", req.id, reason="est_wait",
+                               est_wait_s=round(est, 3))
+                _flight.finish(req.id, "shed", ok=False)
                 raise EngineOverloaded(
                     "est_wait",
                     f"estimated queue wait {est:.1f}s exceeds the "
@@ -865,11 +876,21 @@ class Engine(EnginePrograms):
             if req.resume_ids:
                 self._resume_ctx.pop(req.id, None)
             self.metrics.requests_shed.inc(reason="queue_full")
+            _slo.get().observe_admission(shed=True)
+            _flight.record("shed", req.id, reason="queue_full",
+                           queue_depth=st.queue_depth)
+            _flight.finish(req.id, "shed", ok=False)
             raise EngineOverloaded(
                 "queue_full",
                 f"engine queue is full ({st.queue_depth} waiting, "
                 f"limit {self.serving.max_queue_depth})",
                 retry_after_s=self._estimated_wait_s(st) or 1.0)
+        _slo.get().observe_admission(shed=False)
+        _flight.record("queue", req.id, n_prompt=len(req.prompt_ids),
+                       max_tokens=req.max_tokens)
+        if req.resume_ids:
+            _flight.record("failover_resume", req.id,
+                           n_resume=len(req.resume_ids))
         self._work_event.set()
         return req
 
@@ -918,6 +939,7 @@ class Engine(EnginePrograms):
             self.draining = True
             self._drain_deadline = now + t
         self.metrics.draining.set(1)
+        _flight.record("drain", None, state="begin", timeout_s=t)
         self._work_event.set()
         return t
 
@@ -927,6 +949,7 @@ class Engine(EnginePrograms):
             self.draining = False
             self._drain_deadline = 0.0
         self.metrics.draining.set(0)
+        _flight.record("drain", None, state="end")
         self._work_event.set()
 
     def _effective_deadline(self, req: Request) -> float:
@@ -950,6 +973,8 @@ class Engine(EnginePrograms):
             if r is not None and 0 < self._effective_deadline(r) <= now:
                 r.finish_reason = "timeout"
                 self.metrics.deadline_expired.inc()
+                _flight.record("deadline_reap", r.id, slot=slot,
+                               phase="decode")
                 self._finish(slot)
         st = self._chunk
         if st is not None \
@@ -961,6 +986,9 @@ class Engine(EnginePrograms):
             req.finish_reason = "timeout"
             self.metrics.deadline_expired.inc()
             self.metrics.mark_request("timeout", now - req.t_submit)
+            _flight.record("deadline_reap", req.id, slot=slot,
+                           phase="prefill_chunk")
+            _flight.finish(req.id, "timeout", ok=False)
             req.out_queue.put(None)
         expired = []
         with self._lock:
@@ -977,6 +1005,8 @@ class Engine(EnginePrograms):
             r.finish_reason = "timeout"
             self.metrics.deadline_expired.inc()
             self.metrics.mark_request("timeout", now - r.t_submit)
+            _flight.record("deadline_reap", r.id, phase="queued")
+            _flight.finish(r.id, "timeout", ok=False)
             r.out_queue.put(None)
         if expired:
             self.metrics.queue_depth.set(self.sched.stats().queue_depth)
@@ -1020,6 +1050,7 @@ class Engine(EnginePrograms):
         for slot, r in enumerate(self.slot_req):
             if r is not None and r.cancelled:
                 r.finish_reason = "cancelled"
+                _flight.record("cancel_reap", r.id, slot=slot)
                 self._finish(slot)
         # then expired deadlines — every blocking wait in the pipeline keys
         # off the same t_deadline, so enforcement here (between dispatches)
@@ -1087,6 +1118,8 @@ class Engine(EnginePrograms):
                 self.metrics.queue_depth.set(self.sched.stats().queue_depth)
                 if cand is not None:
                     cand.finish_reason = "cancelled"
+                    _flight.record("cancel_reap", cand.id, phase="queued")
+                    _flight.finish(cand.id, "cancelled", ok=False)
                     cand.out_queue.put(None)
                 continue
             _, rid, slot = action
@@ -1175,6 +1208,8 @@ class Engine(EnginePrograms):
                     self.sched.release(slot)
                     req.finish_reason = "error"
                     self.metrics.mark_request("error", 0.0)
+                    _flight.finish(req.id, "error", ok=False,
+                                   phase="prefill_batch")
                     req.out_queue.put(None)
                 if chunk_next is not None:
                     req, slot, _ = chunk_next
@@ -1182,6 +1217,8 @@ class Engine(EnginePrograms):
                     self.sched.release(slot)
                     req.finish_reason = "error"
                     self.metrics.mark_request("error", 0.0)
+                    _flight.finish(req.id, "error", ok=False,
+                                   phase="prefill_batch")
                     req.out_queue.put(None)
                 raise
             if chunk_next is not None:  # chunking starts next step
@@ -1238,6 +1275,12 @@ class Engine(EnginePrograms):
         status = ("success" if req.finish_reason in ("stop", "length")
                   else req.finish_reason or "success")
         self.metrics.mark_request(status, req.t_done - req.t_submit)
+        # Terminal flight event: OK finishes free the timeline; anomalous
+        # ones (timeout/error/cancelled) snapshot it for /debug/flight and
+        # the spool (drop-on-overflow — never blocks this thread).
+        _flight.finish(req.id, reason=req.finish_reason or "stop",
+                       ok=(status == "success"), slot=slot,
+                       n_generated=len(req.generated))
         if self.paged:
             # Index the GENERATED pages too, so a follow-up turn whose prompt
             # contains this response prefix-hits past the original prompt
@@ -1322,6 +1365,8 @@ class Engine(EnginePrograms):
                     self._stall_abort = True
                 if armed:
                     self.metrics.watchdog_stalls.inc()
+                    _flight.record("watchdog_stall", None,
+                                   stalled_for_s=round(self.stalled_for_s, 3))
             stop.wait(min(1.0, max(0.05, self.STALL_AFTER_S / 4)))
 
     last_error: str = ""
@@ -1344,6 +1389,7 @@ class Engine(EnginePrograms):
         return dt if dt >= self.STALL_AFTER_S else 0.0
 
     def _fail_all(self, reason: str):
+        _flight.record("fail_all", None, reason=reason)
         # Discard the in-flight pipelined decode outright: its requests are
         # failed below through the normal slot teardown (exactly-once page/
         # slot release via _finish), and fetching a dispatch that may BE the
@@ -1357,6 +1403,7 @@ class Engine(EnginePrograms):
             self.sched.release(st["slot"])
             st["req"].finish_reason = "error"
             self.metrics.mark_request("error", 0.0)
+            _flight.finish(st["req"].id, "error", ok=False, detail=reason)
             st["req"].out_queue.put(None)
         if self.paged:
             self._resume_ctx.clear()   # queued resumes are failed below
@@ -1370,6 +1417,7 @@ class Engine(EnginePrograms):
             self.sched.cancel(r.id)
             r.finish_reason = "error"
             self.metrics.mark_request("error", 0.0)
+            _flight.finish(r.id, "error", ok=False, detail=reason)
             r.out_queue.put(None)
         # Drain the scheduler's cancelled-in-queue notifications so its queue
         # empties (the Request objects were already notified above). A request

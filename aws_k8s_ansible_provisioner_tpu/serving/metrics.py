@@ -44,27 +44,41 @@ class Counter:
 
 
 class Gauge:
-    def __init__(self, name: str, help_: str):
+    """Gauge, optionally labeled (e.g. tpu_serve_slo_burn_rate{objective,
+    window}). The unlabeled form keeps the original single-value behavior:
+    it always renders exactly one sample, 0.0 until the first set()."""
+
+    def __init__(self, name: str, help_: str, labelnames: Sequence[str] = ()):
         self.name, self.help = name, help_
-        self._value = 0.0
+        self.labelnames = tuple(labelnames)
+        self._values: Dict[_LabelKey, float] = {}
         self._lock = threading.Lock()
 
-    def set(self, v: float):
+    def set(self, v: float, **labels):
+        key = tuple(sorted(labels.items()))
         with self._lock:
-            self._value = float(v)
+            self._values[key] = float(v)
 
-    def add(self, v: float):
+    def add(self, v: float, **labels):
+        key = tuple(sorted(labels.items()))
         with self._lock:
-            self._value += v
+            self._values[key] = self._values.get(key, 0.0) + v
 
-    def value(self) -> float:
+    def value(self, **labels) -> float:
         """Current value (admission-control wait estimation, tests)."""
+        key = tuple(sorted(labels.items()))
         with self._lock:
-            return self._value
+            return self._values.get(key, 0.0)
 
     def collect(self) -> List[str]:
-        return [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge",
-                f"{self.name} {self._value}"]
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key, val in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(key)} {val}")
+            if not self._values:
+                out.append(f"{self.name} 0.0")
+        return out
 
 
 class Histogram:
@@ -257,3 +271,10 @@ class EngineMetrics:
         self.vllm_request_total.inc(status=status)
         self.request_duration.observe(duration_s)
         self.vllm_request_duration.observe(duration_s)
+        # Every terminal edge already funnels through here — feed the SLO
+        # burn-rate engine from the same single point (serving/slo.py; the
+        # deferred import breaks the metrics <- slo module cycle and costs a
+        # cached-module dict lookup per request).
+        from aws_k8s_ansible_provisioner_tpu.serving import slo as _slo
+
+        _slo.get().observe_request(status, duration_s)

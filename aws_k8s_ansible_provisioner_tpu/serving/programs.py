@@ -53,7 +53,9 @@ from aws_k8s_ansible_provisioner_tpu.ops.sampling import (apply_penalties,
                                                            per_slot_keys,
                                                            sample)
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
+from aws_k8s_ansible_provisioner_tpu.serving import flightrec as _flight
 from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
+from aws_k8s_ansible_provisioner_tpu.serving import slo as _slo
 
 
 # ---------------------------------------------------------------------------
@@ -1076,6 +1078,11 @@ class EnginePrograms:
         if not req.t_first_token:     # don't re-observe on preemption resume
             req.t_first_token = now
             self.metrics.ttft.observe(now - req.t_submit)
+            _slo.get().observe_ttft(now - req.t_submit)
+        _flight.record("admit", req.id, slot=slot, resumed=resumed,
+                       queue_wait_s=round(max(0.0, (req.t_prefill_start
+                                                    or now) - req.t_submit),
+                                          6))
         if not resumed:
             # a resume's context tokens were all counted at first admission
             self.metrics.prompt_tokens.inc(len(ids))
@@ -1359,12 +1366,15 @@ class EnginePrograms:
             req.finish_reason = "cancelled"
             self.metrics.mark_request("cancelled",
                                       time.monotonic() - req.t_submit)
+            _flight.record("cancel_reap", req.id, phase="prefill_chunk")
+            _flight.finish(req.id, "cancelled", ok=False)
             req.out_queue.put(None)
             return
         C = st["C"]
         ids = st.get("ids") or req.prompt_ids
         off = st["off"]
         chunk = ids[off:off + C]
+        _flight.record("prefill_chunk", req.id, off=off, n=len(chunk))
         tokens = np.zeros((1, C), np.int32)
         tokens[0, :len(chunk)] = chunk
         t0 = time.monotonic()
@@ -1797,6 +1807,10 @@ class EnginePrograms:
         # un-penalized dispatches return a dummy counts array — keep ours
         self.counts = new_counts if want_pen else real_counts
         self._pipe_carry = (tok, lens, self._carry_gen)
+        # ring-only flight event (no per-request timeline work): a pure
+        # deque append, safe on the async-dispatch half (tpulint R8)
+        _flight.record("pipeline_dispatch", None, horizon=horizon,
+                       batch=len(active))
         return {"out": out, "horizon": horizon, "active": list(active),
                 "gset": gset, "gslots": gslots, "want_lp": want_lp,
                 "want_pen": want_pen, "t0": t0}
@@ -1885,6 +1899,8 @@ class EnginePrograms:
                     jnp.asarray(row, jnp.int32))
         if tail and any(r is not None for r in self.slot_req):
             self._last_ready = t_ready
+        _flight.record("pipeline_fetch", None, horizon=horizon,
+                       emitted=emitted, tail=tail)
         self._tok_times.append((rec["t0"], emitted))
         if len(self._tok_times) >= 2:
             span = time.monotonic() - self._tok_times[0][0]

@@ -47,7 +47,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
-from aws_k8s_ansible_provisioner_tpu.serving import tracing
+from aws_k8s_ansible_provisioner_tpu.serving import flightrec, slo, tracing
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import (
     Counter, Gauge, Registry)
 
@@ -175,6 +175,11 @@ class BackendPool:
         self._last_refresh = 0.0
         # addr -> (active + queued, t_sampled); written by the ~1 Hz poller
         self._load: dict[str, tuple[int, float]] = {}
+        # addr -> (/healthz fleet summary dict, t_sampled); the poller
+        # refreshes this beside /load so /debug/fleet and tools/tputop.py
+        # read SLO burn rates + flight anomalies without fanning out a
+        # scrape per dashboard refresh
+        self._health: dict[str, tuple[dict, float]] = {}
         # prompt-prefix key -> last replica that served it (LRU)
         self._affinity: "collections.OrderedDict[str, str]" = \
             collections.OrderedDict()
@@ -204,6 +209,34 @@ class BackendPool:
     def note_load(self, addr: str, active: int, queued: int):
         with self._lock:
             self._load[addr] = (int(active) + int(queued), time.monotonic())
+
+    def note_health(self, addr: str, health: dict):
+        """Stash a replica's /healthz fleet summary (poller-fed)."""
+        with self._lock:
+            self._health[addr] = (health, time.monotonic())
+
+    def fleet(self) -> dict:
+        """Per-replica fleet view: last /load + /healthz samples with ages
+        (/debug/fleet; tools/tputop.py renders this)."""
+        now = time.monotonic()
+        with self._lock:
+            out = {}
+            for addr in self._addrs:
+                ent: dict = {}
+                ld = self._load.get(addr)
+                if ld is not None:
+                    ent["load"] = ld[0]
+                    ent["load_age_s"] = round(now - ld[1], 2)
+                h = self._health.get(addr)
+                if h is not None:
+                    ent["health"] = h[0]
+                    ent["health_age_s"] = round(now - h[1], 2)
+                ent["cooling"] = addr in self._dead \
+                    and now - self._dead[addr] < self.cooldown_s
+                ent["draining"] = addr in self._draining \
+                    and now - self._draining[addr] < DRAIN_TTL_S
+                out[addr] = ent
+            return out
 
     def note_affinity(self, key: str, addr: str):
         """Remember which replica served this prompt prefix (its pages are
@@ -402,6 +435,16 @@ def start_load_poller(pool: BackendPool, interval_s: float = 1.0,
                                  "rotation", addr)
                     pool.note_load(addr, d.get("active", 0) or 0,
                                    d.get("queued", 0) or 0)
+            # SLO/flight fleet summary rides the same poll (same keep-alive
+            # connection): /healthz carries burn rates, throughput, pool
+            # pressure, and the flight recorder's last anomaly — the data
+            # /debug/fleet and tputop render. A 503 still carries the JSON
+            # (stalled/draining replicas are exactly the interesting rows).
+            conn.request("GET", "/healthz")
+            hresp = conn.getresponse()
+            h = json.loads(hresp.read())
+            if isinstance(h, dict):
+                pool.note_health(addr, h)
         # tpulint: disable=R3 poller survival — a malformed /load reply must degrade to the stale-TTL path, never kill the poller thread
         except Exception:
             # NEVER let a malformed reply kill the poller thread — the
@@ -582,7 +625,8 @@ class RouterHandler(BaseHTTPRequestHandler):
         the loop emits, and guarantees both the dangling hop and the root
         are finished however the loop exits."""
         tracer = self.tracer
-        if tracer is None or self.path in ("/health", "/metrics"):
+        if tracer is None or self.path.split("?")[0] in (
+                "/health", "/metrics", "/debug/fleet"):
             return self._proxy_impl(method)
         parent = tracing.parse_traceparent(
             self.headers.get(tracing.TRACEPARENT_HEADER))
@@ -630,14 +674,33 @@ class RouterHandler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             # The router's OWN counters (not proxied): the engine pods are
             # scraped directly by pod discovery; this route makes the gateway
-            # itself visible to L5.
+            # itself visible to L5. The shared flight/SLO registries render
+            # here too (tpulint R2's both-routes contract) — in the router
+            # process they carry the GATEWAY's view (its own process has no
+            # engine, so burn gauges stay at their exported defaults).
+            slo.get().export()
             body = (self.metrics.registry.render()
-                    + tracing.metrics.registry.render()).encode()
+                    + tracing.metrics.registry.render()
+                    + flightrec.metrics.registry.render()
+                    + slo.metrics.registry.render()).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            return
+        if self.path.split("?")[0] == "/debug/fleet":
+            # Fleet health aggregation (this PR): the poller's last /load +
+            # /healthz sample per replica — burn rates, throughput, pool
+            # pressure, last flight anomaly — in one gateway round trip.
+            # tools/tputop.py renders this; ages tell a dashboard how stale
+            # each row is (a silent replica keeps its last sample + age).
+            self._respond_json(200, {
+                "backends": list(self.pool.addrs()),
+                "cooling_down": self.pool.cooling(),
+                "draining": self.pool.draining(),
+                "replicas": self.pool.fleet(),
+            })
             return
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else None

@@ -28,7 +28,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
-from aws_k8s_ansible_provisioner_tpu.serving import tracing
+from aws_k8s_ansible_provisioner_tpu.serving import flightrec, slo, tracing
 from aws_k8s_ansible_provisioner_tpu.serving.engine import (
     ContextLengthExceeded, EngineOverloaded)
 
@@ -255,6 +255,10 @@ class Handler(BaseHTTPRequestHandler):
             # collector when a request fails
             err["trace_id"] = self._trace_ctx.trace_id
             err["span_id"] = self._trace_ctx.span_id
+        # ring-only black-box breadcrumb: 5xx edges land in /debug/events
+        # beside the engine's own events (4xx are client errors — noise)
+        if code >= 500:
+            flightrec.record("http_error", None, code=code, type=err_type)
         self._json(code, {"error": err}, headers=headers)
 
     def _overloaded(self, e: EngineOverloaded):
@@ -307,8 +311,11 @@ class Handler(BaseHTTPRequestHandler):
             from aws_k8s_ansible_provisioner_tpu.k8s.metrics_exporter import (
                 render_engine_chips)
 
+            slo.get().export()      # refresh the burn-rate gauges
             body = (self.state.engine.metrics.registry.render()
                     + tracing.metrics.registry.render()
+                    + flightrec.metrics.registry.render()
+                    + slo.metrics.registry.render()
                     + render_engine_chips()).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -374,6 +381,18 @@ class Handler(BaseHTTPRequestHandler):
                 "preemptions_total": int(eng.metrics.preemptions.total()),
                 "max_queue_depth": eng.serving.max_queue_depth or None,
                 "request_timeout_s": eng.serving.request_timeout_s or None,
+                # Fleet-view block (this PR): the router's /debug/fleet and
+                # tools/tputop.py read throughput, pool pressure, SLO burn
+                # rates, and the flight recorder's last anomaly from the
+                # SAME probe the reconcile loop already polls — no extra
+                # scrape+parse round trip per replica.
+                "tokens_per_second":
+                    round(eng.metrics.tokens_per_second.value(), 2),
+                "kv_pages_total": int(eng.metrics.kv_pages_total.value()),
+                "kv_pages_in_use": int(eng.metrics.kv_pages_in_use.value()),
+                "slo": slo.get().snapshot(),
+                "slo_burning": slo.get().burning(),
+                "flight": flightrec.get().summary(),
             })
         elif path == "/readyz":
             # Readiness, distinct from liveness (r8): a DRAINING replica is
@@ -406,6 +425,28 @@ class Handler(BaseHTTPRequestHandler):
             self._admin_drain({})
         elif path == "/debug/profile":
             self._profile()
+        elif path == "/debug/events":
+            # the flight recorder's live ring, oldest first (?last=N caps it)
+            import urllib.parse
+
+            n, q = 100, self.path.split("?", 1)
+            if len(q) == 2:
+                vals = urllib.parse.parse_qs(q[1]).get("last")
+                if vals and vals[0].isdigit():
+                    n = min(int(vals[0]), 4096)
+            self._json(200, {"events": flightrec.get().tail(n)})
+        elif path.startswith("/debug/flight/"):
+            # anomaly snapshot (or live timeline) for one request id
+            rid = path[len("/debug/flight/"):]
+            dump = flightrec.get().dump_for(rid)
+            if dump is None and rid.isdigit():
+                # engine request ids are ints; the URL hands us a string
+                dump = flightrec.get().dump_for(int(rid))
+            if dump is None:
+                return self._error(404, f"no flight timeline for {rid!r} "
+                                        "(snapshots keep the last anomalies "
+                                        "only; see /debug/events)")
+            self._json(200, dump)
         else:
             self._error(404, f"no route for GET {path}")
 
@@ -907,6 +948,16 @@ class Handler(BaseHTTPRequestHandler):
         # hand the engine requests to the tracing wrapper: their monotonic
         # timestamps become the phase spans after the response is written
         self._trace_reqs = reqs
+        if self._trace_ctx is not None:
+            # bind the span identity into each engine request's flight
+            # timeline: an anomaly dump hoists these to its top level, so
+            # /debug/flight/<id> hands back the exact ids to paste into
+            # Tempo beside the PR 5 phase spans
+            for r in reqs:
+                flightrec.record("trace", r.id,
+                                 trace_id=self._trace_ctx.trace_id,
+                                 span_id=self._trace_ctx.span_id,
+                                 api_id=rid)
         if stream:
             self._stream_response(reqs, rid, chat, stops, model=model,
                                   n_prompt=len(prompt_ids),
@@ -1518,6 +1569,14 @@ def build_state(serving_cfg=None, model_cfg=None, params=None,
         "tpu-serve-engine",
         endpoint=getattr(serving, "otlp_endpoint", "") or None,
         sample=getattr(serving, "trace_sample", 1.0))
+    # Flight recorder + SLO engine: module singletons the engine's record/
+    # finish shorthands already write through — configure() swaps in the
+    # served settings (spool dir, objectives) atomically.
+    flightrec.configure(
+        spool_dir=getattr(serving, "flight_spool_dir", "") or "")
+    slo.configure(
+        ttft_p95_ms=getattr(serving, "slo_ttft_p95_ms", 0.0),
+        error_rate=getattr(serving, "slo_error_rate", 0.01))
     return state
 
 
@@ -1657,6 +1716,19 @@ def main(argv=None):
     p.add_argument("--trace-sample", type=float, default=1.0,
                    help="root-span sampling probability in [0, 1]; "
                         "propagated contexts keep the caller's decision")
+    p.add_argument("--slo-ttft-p95-ms", type=float, default=0.0,
+                   help="TTFT p95 objective in milliseconds: first tokens "
+                        "slower than this burn the 5%% latency error budget "
+                        "(tpu_serve_slo_burn_rate{objective=\"ttft_p95\"}); "
+                        "0 disables the objective")
+    p.add_argument("--slo-error-rate", type=float, default=0.01,
+                   help="error-rate SLO budget: the allowed fraction of "
+                        "requests finishing error/timeout; burn rate 1.0 "
+                        "means failing exactly at budget (0 disables)")
+    p.add_argument("--flight-spool-dir", default="",
+                   help="directory for the flight recorder's anomaly dump "
+                        "spool (capped JSONL; rolled at 16 MiB); empty "
+                        "keeps dumps in memory only (/debug/flight/<id>)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--aot-manifest", default="",
                    help="AOT compile manifest (serving/aot.py) to adopt: "
@@ -1716,6 +1788,9 @@ def main(argv=None):
         drain_timeout_s=args.drain_timeout,
         otlp_endpoint=args.otlp_endpoint,
         trace_sample=args.trace_sample,
+        slo_ttft_p95_ms=args.slo_ttft_p95_ms,
+        slo_error_rate=args.slo_error_rate,
+        flight_spool_dir=args.flight_spool_dir,
         mesh=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep))
     state = build_state(serving)
     if args.aot_manifest:

@@ -88,8 +88,31 @@ def _scrape_counter(port: int, name: str) -> float:
     return total
 
 
+def capture_profile_window(url: str, ms: int, timeout: float = 30.0):
+    """Capture ONE decode-window device trace via a replica's
+    ``/debug/profile?ms=N`` (server.py: jax.profiler start/stop under the
+    profile lock). Returns the endpoint's JSON — ``trace_dir`` is the
+    on-disk trace the sweep record points at — or ``{"error": ...}`` when
+    the replica refused or the transport failed; the sweep must keep
+    measuring either way."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                url.rstrip("/") + f"/debug/profile?ms={int(ms)}",
+                timeout=timeout + ms / 1e3) as r:
+            out = json.loads(r.read())
+            return out if isinstance(out, dict) else {"error": str(out)}
+    except urllib.error.HTTPError as e:
+        return {"error": f"/debug/profile={e.code} {e.read()[:120]!r}"}
+    except (OSError, ValueError) as e:
+        return {"error": str(e)[:200]}
+
+
 def router_bench(n_streams: int, n_groups: int, n_replicas: int,
-                 n_requests: int, out_path: str) -> int:
+                 n_requests: int, out_path: str,
+                 profile_ms: int = 0) -> int:
     """Drive the real router + real engine replicas with concurrent streams.
 
     Affinity design: requests belong to ``n_groups`` conversation groups
@@ -197,6 +220,12 @@ def router_bench(n_streams: int, n_groups: int, n_replicas: int,
     threads = [threading.Thread(target=client) for _ in range(n_streams)]
     for t in threads:
         t.start()
+    profile = None
+    if profile_ms > 0:
+        # one decode-window trace from replica 0 WHILE the load is flowing —
+        # the trace must show steady-state batching, not an idle engine
+        profile = capture_profile_window(f"http://127.0.0.1:{BASE}",
+                                         profile_ms)
     for t in threads:
         t.join()
     wall = time.monotonic() - t_start
@@ -253,6 +282,11 @@ def router_bench(n_streams: int, n_groups: int, n_replicas: int,
         "router_failovers": int(RouterHandler.metrics.failovers.total()),
         "errors": errors[:5],
     }
+    if profile is not None:
+        # the sweep record carries the trace's path (or the capture error):
+        # "which config was slow" and "what the chip was doing" land in one
+        # artifact instead of two terminals
+        result["profile_window"] = profile
     with open(out_path, "w") as f:
         f.write(json.dumps(result, indent=1) + "\n")
     print(json.dumps(result))
@@ -421,6 +455,11 @@ def main() -> int:
     ap.add_argument("--router-replicas", type=int, default=2)
     ap.add_argument("--router-requests", type=int, default=48)
     ap.add_argument("--router-out", default="ROUTER_BENCH.json")
+    ap.add_argument("--profile-window", type=int, default=0, metavar="MS",
+                    help="router mode: capture one /debug/profile decode-"
+                         "window trace of MS milliseconds from replica 0 "
+                         "while the load is flowing; the trace path is "
+                         "recorded in the sweep JSON (profile_window)")
     ap.add_argument("--overload", action="store_true",
                     help="overload mode (CPU): drive offered load through "
                          "the router past the replicas' admission limits "
@@ -439,7 +478,8 @@ def main() -> int:
     if args.router > 0:
         return router_bench(args.router, args.router_groups,
                             args.router_replicas, args.router_requests,
-                            args.router_out)
+                            args.router_out,
+                            profile_ms=args.profile_window)
     grid = parse_grid(args.grid) if args.grid \
         else (TTFT_GRID if args.ttft else DEFAULT_GRID)
     keys = sorted(grid)

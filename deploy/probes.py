@@ -35,6 +35,8 @@ Env overrides (rehearsals and tests):
   REHEARSE_GW_ADDR      gateway host:port (L4)
   TPU_PROBE_COLLECTOR   http URL probed instead of kubectl for L5
   REHEARSE_ENGINE_IP    default replica host when kubectl lookup is empty
+  TPU_PROBE_SLO         L3 burn-rate threshold for the slo: ok|burning
+                        detail (default 1.0; '0'/'off' disables the check)
 """
 
 from __future__ import annotations
@@ -201,18 +203,60 @@ def replica_addrs(gv: Dict, inventory: Optional[str]) -> List[str]:
     return [f"{ip}:{port}" for ip in ips]
 
 
+def _slo_burn_threshold() -> Optional[float]:
+    """TPU_PROBE_SLO: unset/empty -> 1.0 (the burning-exactly-the-budget
+    line), '0'/'off' -> disabled, numeric -> that burn-rate threshold."""
+    raw = os.environ.get("TPU_PROBE_SLO", "").strip().lower()
+    if raw in ("0", "0.0", "off"):
+        return None
+    if not raw:
+        return 1.0
+    try:
+        return float(raw)
+    except ValueError:
+        return 1.0
+
+
 def probe_l3(gv: Dict, inventory: Optional[str]) -> ProbeResult:
     addrs = replica_addrs(gv, inventory)
     if not addrs:
         return ProbeResult("L3", False, "no serving replicas discovered")
     bad = []
+    burning = []
+    threshold = _slo_burn_threshold()
     for addr in addrs:
         status, body = _http_get(f"http://{addr}/readyz")
         if status != 200:
             bad.append(f"{addr} /readyz={status} {body[:80]}")
+        if threshold is None:
+            continue
+        # SLO burn context (serving/slo.py, via /healthz): informational
+        # only — a replica over budget is SERVING, just badly, and the
+        # reconciler must not "repair" it into an outage. The detail tells
+        # the operator where to point tpu-top / the flight recorder.
+        h_status, h_body = _http_get(f"http://{addr}/healthz")
+        if h_status != 200:
+            continue
+        try:
+            h = json.loads(h_body)
+        except ValueError:
+            continue
+        for obj, d in sorted((h.get("slo") or {}).items()):
+            try:
+                burn = float(d.get("5m", 0.0))
+            except (TypeError, AttributeError, ValueError):
+                continue
+            if burn >= threshold:
+                burning.append(f"{addr}:{obj}={burn:g}")
+                break
+    slo_detail = ""
+    if threshold is not None:
+        slo_detail = ", slo: " + (f"burning({', '.join(burning)})"
+                                  if burning else "ok")
     return ProbeResult("L3", not bad,
                        f"{len(addrs)} replica(s) "
-                       + ("all ready" if not bad else "; ".join(bad)))
+                       + ("all ready" if not bad else "; ".join(bad))
+                       + slo_detail)
 
 
 def gateway_addr(gv: Dict, inventory: Optional[str]) -> str:

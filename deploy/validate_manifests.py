@@ -129,6 +129,27 @@ def _validate_workload(d: dict, name: str):
                             "passes --otlp-endpoint but does not set the "
                             "OTEL_EXPORTER_OTLP_ENDPOINT env var "
                             "(serving/tracing.py's fallback contract)")
+        # Flight-spool pairing (serving/flightrec.py): a --flight-spool-dir
+        # argument must point INSIDE a declared volumeMount of the same
+        # container — black-box dumps written to the container's writable
+        # layer die with the container, which is precisely the moment the
+        # postmortem needs them.
+        for i, a in enumerate(argv):
+            if a != "--flight-spool-dir" or i + 1 >= len(argv):
+                continue
+            spool = (argv[i + 1] or "").rstrip("/") \
+                if isinstance(argv[i + 1], str) else ""
+            if not spool:
+                continue
+            mounts = [(vm.get("mountPath") or "").rstrip("/")
+                      for vm in c.get("volumeMounts") or []]
+            if not any(mp and (spool == mp or spool.startswith(mp + "/"))
+                       for mp in mounts):
+                _fail(name, f"{kind} {mname} container {c.get('name')} "
+                            f"passes --flight-spool-dir {spool!r} but no "
+                            "volumeMount covers that path — flight dumps "
+                            "would die with the container (see "
+                            "serving.yaml.j2 flight-spool)")
         # Compile-cache pairing (AOT cold-start work, serving/aot.py): a
         # JAX_COMPILATION_CACHE_DIR env must point INSIDE a declared
         # volumeMount of the same container — a cache on the container's

@@ -1,0 +1,382 @@
+"""Flight recorder (serving/flightrec.py): black-box request timelines.
+
+The contract under test is the span exporter's, verbatim: recording is
+drop-on-overflow and can NEVER block or fail a request. Headline scenarios
+(tier-1 via the ``flight_smoke`` marker, focused driver ``make
+flight-smoke``):
+
+- a chaos-induced deadline expiry yields a spooled dump whose timeline
+  carries the complete admit -> deadline_reap -> finish edge sequence plus
+  the request's trace/span ids, all served by ``/debug/flight/<id>``;
+- the injected ``flight_dump_error`` fault is counted
+  (``tpu_serve_flight_drops_total{reason="dump_error"}``) and costs only
+  the on-disk dump — the in-memory snapshot still serves, and requests
+  neither fail nor stall;
+- seeded streams are byte-identical recorder on vs off.
+
+Engine builds dominate this file's wall time on CPU (every Engine re-jits
+its program set), so the HTTP end-to-end phases share ONE server and the
+determinism check reuses ONE engine — seeded sampling is per-(seed,
+position) keyed, so two passes over the same engine are the contract.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
+from aws_k8s_ansible_provisioner_tpu.serving import flightrec, slo
+from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
+from aws_k8s_ansible_provisioner_tpu.serving.flightrec import FlightRecorder
+from aws_k8s_ansible_provisioner_tpu.serving.server import build_state, serve
+from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+pytestmark = pytest.mark.flight_smoke
+
+MODEL = "tiny-qwen3"
+_PORTS = iter(range(18700, 18760))
+
+SEEDED = dict(prompt_ids=[5, 9, 2], max_tokens=10, temperature=0.9,
+              ignore_eos=True, seed=42)
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    """Chaos + recorder + SLO singletons are process-global; every test
+    gets (and leaves behind) fresh ones."""
+    _chaos.reset()
+    flightrec.reset()
+    slo.reset()
+    yield
+    _chaos.reset()
+    flightrec.reset()
+    slo.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    return tok, cfg, params
+
+
+def _engine(model, **over):
+    tok, cfg, params = model
+    base = dict(weights_dtype="bf16", model=MODEL, max_decode_slots=2,
+                max_cache_len=128, page_size=32,
+                prefill_buckets=(16, 32, 64, 128), dtype="float32",
+                derived_seed=0)
+    base.update(over)
+    return Engine(cfg, params, ServingConfig(**base))
+
+
+def _drain(eng, limit=20000):
+    for _ in range(limit):
+        if not eng.step():
+            return
+    raise AssertionError("engine failed to quiesce")
+
+
+@pytest.fixture()
+def http_server(model):
+    tok, cfg, params = model
+    stops = []
+
+    def make(**over):
+        base = dict(weights_dtype="bf16", model=MODEL, max_decode_slots=2,
+                    max_cache_len=128, page_size=32,
+                    prefill_buckets=(16, 32, 64, 128), dtype="float32",
+                    derived_seed=0)
+        base.update(over)
+        state = build_state(ServingConfig(**base), model_cfg=cfg,
+                            params=params, tokenizer=tok)
+        port = next(_PORTS)
+        ready, stop = threading.Event(), threading.Event()
+        threading.Thread(target=serve,
+                         args=(state, "127.0.0.1", port, ready, stop),
+                         daemon=True).start()
+        assert ready.wait(10)
+        stops.append(stop)
+        return state, port
+
+    yield make
+    for s in stops:
+        s.set()
+    time.sleep(0.1)
+
+
+def _post(port, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps({"model": MODEL, **payload}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def _wait(pred, timeout_s=5.0):
+    """flush() can return in the sliver between the spool worker's q.get()
+    and _busy=True, so on-disk/counter assertions poll briefly."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# Recorder unit behavior: ring, timelines, snapshots, bounds
+# ---------------------------------------------------------------------------
+
+
+def test_ring_timeline_and_anomaly_snapshot():
+    rec = FlightRecorder(enabled=True)
+    try:
+        rec.record("admit", rid=7, slot=0)
+        rec.record("trace", rid=7, trace_id="ab" * 16, span_id="cd" * 8)
+        rec.record("heartbeat")                       # ring-only, no rid
+        tail = rec.tail(10)
+        assert [e["type"] for e in tail] == ["admit", "trace", "heartbeat"]
+        assert tail[0]["request_id"] == 7
+        assert tail[0]["t_mono_ns"] <= tail[1]["t_mono_ns"]
+        assert all("t_unix_ns" in e for e in tail)
+        # a still-running request serves its LIVE timeline
+        live = rec.dump_for(7)
+        assert live["live"] and len(live["events"]) == 2
+        # healthy finish: timeline freed, no snapshot
+        rec.finish(7, "stop")
+        assert rec.dump_for(7) is None
+        # anomalous finish: snapshot with the full timeline + hoisted ids
+        rec.record("admit", rid=8, slot=1)
+        rec.record("trace", rid=8, trace_id="12" * 16, span_id="34" * 8)
+        rec.record("deadline_reap", rid=8, slot=1)
+        rec.finish(8, "timeout", ok=False)
+        dump = rec.dump_for(8)
+        assert dump["reason"] == "timeout"
+        assert dump["trace_id"] == "12" * 16
+        assert [e["type"] for e in dump["events"]] == [
+            "admit", "trace", "deadline_reap", "finish"]
+        assert dump["events"][-1]["ok"] is False
+        last = rec.summary()["last_anomaly"]
+        assert last["request_id"] == 8 and last["reason"] == "timeout"
+    finally:
+        rec.shutdown()
+
+
+def test_overflow_drops_are_counted_never_raised():
+    rec = FlightRecorder(enabled=True, max_requests=2,
+                         max_events_per_request=3)
+    try:
+        d0 = flightrec.metrics.drops.total()
+        for i in range(6):                  # 3 over the per-request bound
+            rec.record("evt", rid=1, i=i)
+        rec.record("evt", rid=2)
+        rec.record("evt", rid=3)            # over the request-count bound
+        assert flightrec.metrics.drops.total() - d0 == 4
+        assert len(rec.dump_for(1)["events"]) == 3
+        assert rec.dump_for(3) is None
+    finally:
+        rec.shutdown()
+
+
+def test_spool_write_and_roll(tmp_path):
+    rec = FlightRecorder(spool_dir=str(tmp_path), spool_max_bytes=64)
+    try:
+        rec.record("admit", rid=1)
+        rec.finish(1, "error", ok=False)
+        path = os.path.join(str(tmp_path), "flight.jsonl")
+        assert _wait(lambda: os.path.exists(path))
+        lines = open(path).read().splitlines()
+        assert json.loads(lines[0])["request_id"] == 1
+        # over the byte budget: the next dump rolls the file aside first
+        rec.record("admit", rid=2)
+        rec.finish(2, "error", ok=False)
+        assert _wait(lambda: os.path.exists(path + ".1"))
+        assert json.loads(open(path).read())["request_id"] == 2
+    finally:
+        rec.shutdown()
+
+
+def test_disabled_recorder_is_inert(tmp_path):
+    rec = FlightRecorder(spool_dir=str(tmp_path), enabled=False)
+    e0 = flightrec.metrics.events.total()
+    rec.record("admit", rid=1)
+    rec.finish(1, "error", ok=False)
+    assert flightrec.metrics.events.total() == e0
+    assert rec.tail(10) == [] and rec.dump_for(1) is None
+    assert not os.listdir(str(tmp_path))
+    assert rec.summary()["enabled"] is False
+
+
+def test_flight_dump_error_counted_not_felt(tmp_path):
+    """An injected spool-write fault (disk full) costs exactly the on-disk
+    dump: the finish() call returns instantly, the in-memory snapshot still
+    serves, and the failure lands in tpu_serve_flight_drops_total."""
+    _chaos.get().inject("flight_dump_error", times=-1)
+    rec = FlightRecorder(spool_dir=str(tmp_path))
+    try:
+        f0 = flightrec.metrics.dump_failures.total()
+        d0 = flightrec.metrics.drops.total()
+        rec.record("admit", rid=5)
+        t0 = time.monotonic()
+        rec.finish(5, "error", ok=False)
+        assert time.monotonic() - t0 < 0.2, \
+            "finish() must not wait on the (failing) spool writer"
+        assert _wait(lambda: flightrec.metrics.dump_failures.total() - f0 == 1)
+        assert flightrec.metrics.drops.total() - d0 == 1
+        assert rec.dump_for(5)["reason"] == "error"      # memory survives
+        assert not os.path.exists(os.path.join(str(tmp_path),
+                                               "flight.jsonl"))
+    finally:
+        rec.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Headline end-to-end (ONE server, phased: ring endpoints -> chaos-induced
+# deadline dump -> SLO gauges on the engine /metrics route -> spool faulted)
+# ---------------------------------------------------------------------------
+
+
+def test_black_box_end_to_end(http_server, tmp_path):
+    _state, port = http_server(flight_spool_dir=str(tmp_path))
+
+    # -- /debug/events pagination + the 404 contract ------------------------
+    for i in range(5):
+        flightrec.record("tick", None, i=i)
+    _, ev = _get(port, "/debug/events?last=3")
+    ticks = [e for e in ev["events"] if e["type"] == "tick"]
+    assert len(ev["events"]) == 3 and ticks[-1]["i"] == 4
+    st, body = _get(port, "/debug/events")
+    assert st == 200 and len(body["events"]) >= 5
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(port, "/debug/flight/no-such-request")
+    assert ei.value.code == 404
+
+    # -- chaos-induced deadline expiry -> complete spooled timeline ---------
+    # warm the jit caches so admission of the doomed request is fast
+    code, _ = _post(port, {"prompt": "warm", "max_tokens": 4,
+                           "ignore_eos": True})
+    assert code == 200
+    # wedge the FIRST decode step of the next request well past its deadline
+    _chaos.get().inject("stalled_decode", duration_s=3.0, times=1)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, {"prompt": "doomed", "max_tokens": 50,
+                     "ignore_eos": True, "deadline_ms": 1000, "seed": 7})
+    assert ei.value.code == 408
+
+    _, ev = _get(port, "/debug/events?last=500")
+    types = [e["type"] for e in ev["events"]]
+    assert "chaos_fault" in types            # the injected stall is on film
+    reaps = [e for e in ev["events"] if e["type"] == "deadline_reap"]
+    assert reaps, f"no deadline_reap in ring: {types}"
+    rid = reaps[-1]["request_id"]
+
+    _, dump = _get(port, f"/debug/flight/{rid}")
+    assert dump["reason"] == "timeout"
+    dtypes = [e["type"] for e in dump["events"]]
+    for expected in ("trace", "queue", "admit", "deadline_reap", "finish"):
+        assert expected in dtypes, f"{expected} missing from {dtypes}"
+    assert dtypes.index("admit") < dtypes.index("deadline_reap") \
+        < dtypes.index("finish")
+    assert re.fullmatch(r"[0-9a-f]{32}", dump["trace_id"])
+    assert re.fullmatch(r"[0-9a-f]{16}", dump["span_id"])
+    trace_evt = next(e for e in dump["events"] if e["type"] == "trace")
+    assert trace_evt["trace_id"] == dump["trace_id"]
+
+    # the same dump landed in the JSONL spool
+    spool = os.path.join(str(tmp_path), "flight.jsonl")
+    assert _wait(lambda: os.path.exists(spool) and
+                 str(rid) in open(spool).read())
+    spooled = [json.loads(ln) for ln in open(spool)]
+    mine = [d for d in spooled if d["request_id"] == rid]
+    assert mine and mine[0]["trace_id"] == dump["trace_id"]
+    assert [e["type"] for e in mine[0]["events"]] == dtypes
+
+    # -- SLO burn gauges on the ENGINE /metrics route -----------------------
+    # traffic so far: 1 ok + 1 timeout -> error-rate burn (1/2)/0.01 = 50x
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=30) as r:
+        text = r.read().decode()
+    assert ('tpu_serve_slo_burn_rate'
+            '{objective="error_rate",window="5m"} 50.0') in text
+    assert ('tpu_serve_slo_burn_rate'
+            '{objective="error_rate",window="1h"} 50.0') in text
+    assert "tpu_serve_flight_events_total" in text
+    _, health = _get(port, "/healthz")
+    assert health["slo"]["error_rate"]["5m"] == pytest.approx(50.0)
+    assert health["slo_burning"] == "error_rate"
+    assert health["flight"]["dumps_total"] >= 1
+
+    # -- spool faulted for good: requests still answer, drops count ---------
+    _chaos.get().inject("flight_dump_error", times=-1)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, {"prompt": "never finishes", "max_tokens": 100,
+                     "ignore_eos": True, "deadline_ms": 1})
+    assert ei.value.code == 408
+    code, _body = _post(port, {"prompt": "hello", "max_tokens": 4})
+    assert code == 200
+    assert _wait(lambda: flightrec.metrics.dump_failures.total() >= 1)
+    _, health = _get(port, "/healthz")
+    assert health["flight"]["drops_total"] >= 1
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=30) as r:
+        text = r.read().decode()
+    assert re.search(r'tpu_serve_flight_drops_total\{reason="dump_error"\} '
+                     r'[1-9]', text)
+
+
+# ---------------------------------------------------------------------------
+# Determinism: recorder on vs off changes nothing a client can see
+# ---------------------------------------------------------------------------
+
+
+def _stream_bytes(req):
+    lp = None
+    if req.logprob_data is not None:
+        lp = tuple((own, tuple(alts)) for own, alts in req.logprob_data)
+    return (tuple(req.generated), req.finish_reason, lp)
+
+
+def test_seeded_streams_byte_identical_recorder_on_off(model):
+    """The recorder observes the token path, never participates in it:
+    seeded streams must be byte-identical with recording on vs off (same
+    engine, two passes — per-(seed, position) keys make the stream a pure
+    function of position)."""
+    specs = [
+        dict(SEEDED),
+        dict(prompt_ids=[7, 7, 3], max_tokens=12, temperature=0.8, seed=11,
+             ignore_eos=True, logprobs=3),
+        dict(prompt_ids=[23, 42], max_tokens=8, temperature=0.0,
+             ignore_eos=True),
+    ]
+    eng = _engine(model)
+    flightrec.configure(enabled=True)
+    on = [eng.submit(Request(**dict(s))) for s in specs]
+    _drain(eng)
+    assert flightrec.metrics.events.total() > 0
+
+    flightrec.configure(enabled=False)
+    off = [eng.submit(Request(**dict(s))) for s in specs]
+    _drain(eng)
+
+    for a, b in zip(on, off):
+        assert _stream_bytes(a) == _stream_bytes(b), \
+            "recorder on/off must not change the stream"

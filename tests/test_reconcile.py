@@ -114,6 +114,56 @@ def test_probe_l3_and_undrain_repair(monkeypatch):
         srv.shutdown()
 
 
+class _FakeSLOReplica(BaseHTTPRequestHandler):
+    """Ready replica whose /healthz carries a hot SLO snapshot."""
+    burn_5m = 3.0
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/readyz":
+            self._json({"status": "ok"})
+        elif self.path == "/healthz":
+            self._json({"status": "ok", "slo": {
+                "error_rate": {"budget": 0.01,
+                               "5m": type(self).burn_5m, "1h": 0.5}}})
+
+
+def test_probe_l3_slo_detail(monkeypatch):
+    """SLO satellite: L3 reads /healthz burn rates into a NON-REPAIRING
+    `slo: ok|burning` detail — a replica over budget is serving (just
+    badly), so the probe stays ok and the reconciler leaves it alone.
+    TPU_PROBE_SLO overrides the threshold; '0'/'off' disables the check."""
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeSLOReplica)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        addr = f"127.0.0.1:{srv.server_port}"
+        monkeypatch.setenv("TPU_PROBE_REPLICAS", addr)
+        _FakeSLOReplica.burn_5m = 3.0
+        r = probes.probe_l3({}, None)
+        assert r.ok                       # burning is NOT broken
+        assert f"burning({addr}:error_rate=3" in r.detail
+        # threshold override above the burn: detail flips to ok
+        monkeypatch.setenv("TPU_PROBE_SLO", "5.0")
+        r = probes.probe_l3({}, None)
+        assert r.ok and "slo: ok" in r.detail and "burning" not in r.detail
+        # 'off' disables the slo leg entirely
+        monkeypatch.setenv("TPU_PROBE_SLO", "off")
+        r = probes.probe_l3({}, None)
+        assert r.ok and "slo" not in r.detail
+    finally:
+        srv.shutdown()
+
+
 def test_probe_l5_override(monkeypatch):
     monkeypatch.setenv("TPU_PROBE_COLLECTOR", "http://127.0.0.1:1/healthz")
     assert not probes.probe_l5({}, None).ok

@@ -1,0 +1,179 @@
+"""SLO burn-rate engine (serving/slo.py): Google-SRE multi-window burn
+rates over the serving objectives (TTFT p95, e2e p95, error rate, shed
+rate).
+
+The numbers under test are exact, not approximate: the engine takes an
+injectable monotonic clock, so every burn rate here is a deterministic
+function of the scripted samples — (bad fraction in window) / budget.
+The export contract must hold on BOTH /metrics routes: the router route is
+asserted here (no engine build needed); the engine-server route rides
+tests/test_flightrec.py::test_black_box_end_to_end, which already owns a
+running server.
+"""
+
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
+from aws_k8s_ansible_provisioner_tpu.serving import flightrec, slo
+from aws_k8s_ansible_provisioner_tpu.serving.router import (
+    BackendPool, RouterHandler, RouterMetrics)
+from aws_k8s_ansible_provisioner_tpu.serving.slo import SLOEngine
+
+pytestmark = pytest.mark.flight_smoke
+
+
+class FakeClock:
+    """Injectable monotonic clock: tests script the timeline exactly."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    _chaos.reset()
+    flightrec.reset()
+    slo.reset()
+    yield
+    _chaos.reset()
+    flightrec.reset()
+    slo.reset()
+
+
+# ---------------------------------------------------------------------------
+# Exact burn-rate arithmetic on a scripted clock
+# ---------------------------------------------------------------------------
+
+
+def test_error_rate_burn_exact_and_windowed():
+    clk = FakeClock()
+    eng = SLOEngine(error_rate=0.01, clock=clk)
+    for _ in range(95):
+        eng.observe_request("ok", 0.01)
+    for _ in range(5):
+        eng.observe_request("error", 0.01)
+    # 5% errors against a 1% budget: burning 5x, both windows see it
+    assert eng.burn_rate("error_rate", 300.0) == pytest.approx(5.0)
+    assert eng.burn_rate("error_rate", 3600.0) == pytest.approx(5.0)
+    snap = eng.snapshot()
+    assert snap["error_rate"]["budget"] == 0.01
+    assert snap["error_rate"]["5m"] == pytest.approx(5.0)
+    assert snap["error_rate"]["1h"] == pytest.approx(5.0)
+    # deterministic: same clock reading, same answer
+    assert eng.snapshot() == snap
+    # the fast window forgets, the slow window remembers — the SRE pairing
+    clk.t += 301.0
+    assert eng.burn_rate("error_rate", 300.0) == 0.0
+    assert eng.burn_rate("error_rate", 3600.0) == pytest.approx(5.0)
+    # fresh clean traffic dilutes the 1h burn, owns the 5m burn
+    for _ in range(100):
+        eng.observe_request("ok", 0.01)
+    assert eng.burn_rate("error_rate", 300.0) == 0.0
+    assert eng.burn_rate("error_rate", 3600.0) == pytest.approx(2.5)
+
+
+def test_latency_and_shed_objectives():
+    clk = FakeClock()
+    eng = SLOEngine(ttft_p95_ms=100.0, e2e_p95_ms=1000.0, error_rate=0.01,
+                    shed_rate=0.05, clock=clk)
+    # TTFT: 2 of 10 over the 100ms target, 5% budget -> 0.2/0.05 = 4x
+    for _ in range(8):
+        eng.observe_ttft(0.05)
+    for _ in range(2):
+        eng.observe_ttft(0.25)
+    assert eng.burn_rate("ttft_p95", 300.0) == pytest.approx(4.0)
+    # e2e only samples NON-bad requests (a timeout is an error-rate event,
+    # not a latency one): one slow ok of one -> 1.0/0.05 = 20x
+    eng.observe_request("ok", 2.0)
+    eng.observe_request("timeout", 5.0)
+    assert eng.burn_rate("e2e_p95", 300.0) == pytest.approx(20.0)
+    assert eng.burn_rate("error_rate", 300.0) == pytest.approx(50.0)
+    # shed: 1 of 10 against a 5% budget -> 2x
+    for _ in range(9):
+        eng.observe_admission(shed=False)
+    eng.observe_admission(shed=True)
+    assert eng.burn_rate("shed_rate", 300.0) == pytest.approx(2.0)
+    # burning() reports the first objective over threshold, honors threshold
+    assert eng.burning() == "ttft_p95"
+    assert eng.burning(threshold=1000.0) is None
+    snap = eng.snapshot()
+    assert snap["ttft_p95"]["target_s"] == pytest.approx(0.1)
+    assert snap["e2e_p95"]["target_s"] == pytest.approx(1.0)
+
+
+def test_empty_unknown_and_disabled():
+    eng = SLOEngine(clock=FakeClock())
+    assert eng.burn_rate("error_rate", 300.0) == 0.0     # no samples
+    assert eng.burn_rate("no_such_objective", 300.0) == 0.0
+    assert eng.burning() is None
+    # zero/None targets create no objective
+    assert "ttft_p95" not in eng.objectives
+    disabled = SLOEngine(enabled=False, clock=FakeClock())
+    disabled.observe_request("error", 1.0)
+    disabled.observe_ttft(99.0)
+    disabled.observe_admission(shed=True)
+    assert disabled.burn_rate("error_rate", 300.0) == 0.0
+    assert disabled.snapshot()["error_rate"]["5m"] == 0.0
+
+
+def test_export_refreshes_labeled_gauges():
+    clk = FakeClock()
+    e = slo.configure(error_rate=0.01, clock=clk)
+    for _ in range(9):
+        e.observe_request("ok", 0.01)
+    e.observe_request("error", 0.01)
+    e.export()
+    text = slo.metrics.registry.render()
+    assert ('tpu_serve_slo_burn_rate'
+            '{objective="error_rate",window="5m"} 10.0') in text
+    assert ('tpu_serve_slo_burn_rate'
+            '{objective="error_rate",window="1h"} 10.0') in text
+    assert '{objective="shed_rate",window="5m"} 0.0' in text
+    # export is idempotent at a fixed clock; the window decay shows up
+    clk.t += 301.0
+    e.export()
+    text = slo.metrics.registry.render()
+    assert ('tpu_serve_slo_burn_rate'
+            '{objective="error_rate",window="5m"} 0.0') in text
+    assert ('tpu_serve_slo_burn_rate'
+            '{objective="error_rate",window="1h"} 10.0') in text
+
+
+# ---------------------------------------------------------------------------
+# The gauge renders on the ROUTER /metrics route too
+# ---------------------------------------------------------------------------
+
+
+def test_burn_gauge_on_router_metrics_route():
+    """The router renders the same process-wide SLO registry on ITS
+    /metrics — a fleet scrape needs only one target."""
+    e = slo.configure(error_rate=0.01, clock=FakeClock())
+    for _ in range(9):
+        e.observe_request("ok", 0.01)
+    e.observe_request("error", 0.01)
+    old = RouterHandler.pool, RouterHandler.metrics
+    RouterHandler.pool = BackendPool("127.0.0.1:1")
+    RouterHandler.metrics = RouterMetrics()
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.server_port}/metrics",
+                timeout=30) as r:
+            st, text = r.status, r.read().decode()
+        assert st == 200
+        assert ('tpu_serve_slo_burn_rate'
+                '{objective="error_rate",window="5m"} 10.0') in text
+        assert ('tpu_serve_slo_burn_rate'
+                '{objective="error_rate",window="1h"} 10.0') in text
+        assert "tpu_serve_flight_drops_total" in text
+    finally:
+        srv.shutdown()
+        RouterHandler.pool, RouterHandler.metrics = old

@@ -561,6 +561,61 @@ def test_r8_pragma_with_reason_suppresses(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# R9: anomalous terminal edges must hit the flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_r9_fires_on_unrecorded_anomalous_edges(tmp_path):
+    fs = _lint(tmp_path, {"pkg/serving/a.py": """
+        class E:
+            def _reap(self, req):
+                req.finish_reason = "timeout"
+                self._finish(req)
+
+            def _shed(self):
+                self.metrics.requests_shed.inc(reason="queue_full")
+    """}, only=["R9"])
+    assert _rules_of(fs) == ["R9", "R9"]
+    assert 'finish_reason = "timeout"' in fs[0].message
+    assert "requests_shed.inc" in fs[1].message
+    assert all("flight" in f.message for f in fs)
+
+
+def test_r9_clean_when_edge_is_recorded(tmp_path):
+    fs = _lint(tmp_path, {"pkg/serving/a.py": """
+        from pkg.serving import flightrec
+
+        class E:
+            def _reap(self, req):
+                req.finish_reason = "timeout"
+                flightrec.record("deadline_reap", req.id)
+                self._finish(req)
+
+            def _shed(self):
+                from pkg.serving import flightrec as _flight
+                self.metrics.requests_shed.inc(reason="queue_full")
+                _flight.finish(None, reason="shed")
+
+            def _finish(self, req):
+                # healthy reasons and dynamic reasons are not edges
+                req.finish_reason = "stop"
+                other = req.finish_reason
+                req.finish_reason = other
+    """}, only=["R9"])
+    assert fs == []
+
+
+def test_r9_pragma_with_reason_suppresses(tmp_path):
+    fs = _lint(tmp_path, {"pkg/serving/a.py": """
+        class E:
+            def _relabel(self, req):
+                # tpulint: disable=R9 re-labels an edge already recorded upstream
+                req.finish_reason = "timeout"
+    """}, only=["R9"])
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
 # runner semantics
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,180 @@
+"""tpu-top (tools/tputop.py): the refresh-in-place fleet dashboard.
+
+``render(fleet)`` is a pure function of the /debug/fleet dict, so the frame
+tests assert exact strings with no sockets. The integration test runs the
+real chain the dashboard rides in production: engine server -> router
+poller (/load + /healthz on one connection) -> BackendPool.fleet() ->
+router /debug/fleet -> fetch_fleet -> render.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
+from aws_k8s_ansible_provisioner_tpu.serving import flightrec, slo
+from aws_k8s_ansible_provisioner_tpu.serving.router import (
+    BackendPool, start_load_poller)
+from aws_k8s_ansible_provisioner_tpu.serving.server import build_state, serve
+from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+from tools import tputop
+
+pytestmark = pytest.mark.flight_smoke
+
+MODEL = "tiny-qwen3"
+_PORTS = iter(range(18800, 18840))
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    _chaos.reset()
+    flightrec.reset()
+    slo.reset()
+    yield
+    _chaos.reset()
+    flightrec.reset()
+    slo.reset()
+
+
+# ---------------------------------------------------------------------------
+# Pure frame rendering
+# ---------------------------------------------------------------------------
+
+
+def _healthy(burn_5m=0.0, anomaly=None):
+    return {
+        "status": "ok", "tokens_per_second": 12.34, "active_requests": 1,
+        "queue_depth": 0, "kv_pages_total": 64, "kv_pages_in_use": 8,
+        "decode_bubble_pct": 3.5,
+        "slo": {"error_rate": {"budget": 0.01, "5m": burn_5m, "1h": 0.5}},
+        "flight": {"last_anomaly": anomaly},
+    }
+
+
+def test_render_empty_fleet():
+    frame = tputop.render({"replicas": {}})
+    assert "0 replicas" in frame
+    assert "SLO ok" in frame
+    assert "(no replicas)" in frame
+
+
+def test_render_rows_and_burning_header():
+    fleet = {
+        "backends": ["a:1", "b:2"], "cooling_down": ["b:2"], "draining": [],
+        "replicas": {
+            "a:1": {"cooling": False, "draining": False, "health_age_s": 0.5,
+                    "health": _healthy(
+                        burn_5m=3.0,
+                        anomaly={"reason": "timeout", "request_id": 7})},
+            "b:2": {"cooling": True, "draining": False},
+        },
+    }
+    frame = tputop.render(fleet)
+    lines = frame.splitlines()
+    assert lines[0] == "tpu-top — 2 replicas, 1 cooling, SLO BURNING: a:1"
+    assert lines[1].split() == list(tputop.COLUMNS)[:-1] + ["last", "anomaly"]
+    row_a = next(ln for ln in lines if ln.startswith("a:1"))
+    assert "12.3" in row_a and "8/64" in row_a and "3.5" in row_a
+    assert "3.00 error_rate" in row_a      # >= BURN_WARN names the objective
+    assert "timeout 7" in row_a
+    row_b = next(ln for ln in lines if ln.startswith("b:2"))
+    assert "dead?" in row_b                # cooling replica, no health row
+
+
+def test_render_draining_and_subthreshold_burn():
+    fleet = {
+        "backends": ["a:1"], "cooling_down": [], "draining": ["a:1"],
+        "replicas": {
+            "a:1": {"cooling": False, "draining": True,
+                    "health": _healthy(burn_5m=0.4)},
+        },
+    }
+    frame = tputop.render(fleet)
+    assert "1 replica," in frame and "1 draining" in frame
+    assert "SLO ok" in frame               # 0.4 < BURN_WARN: no alarm
+    row = next(ln for ln in frame.splitlines() if ln.startswith("a:1"))
+    assert "drain" in row
+    assert "0.40" in row and "error_rate" not in row
+
+
+def test_fetch_replicas_tolerates_dead_addr():
+    fleet = tputop.fetch_replicas(["127.0.0.1:9"])   # nothing listens
+    assert fleet["replicas"]["127.0.0.1:9"] == {"cooling": False,
+                                                "draining": False}
+    frame = tputop.render(fleet)
+    assert "1 replica," in frame
+    assert "?" in frame.splitlines()[2]    # unknown status renders, no crash
+
+
+# ---------------------------------------------------------------------------
+# The real chain: engine -> poller -> /debug/fleet -> render
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_aggregation_end_to_end(tmp_path):
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    state = build_state(
+        ServingConfig(weights_dtype="bf16", model=MODEL, max_decode_slots=2,
+                      max_cache_len=128, page_size=32,
+                      prefill_buckets=(16, 32, 64, 128), dtype="float32",
+                      derived_seed=0),
+        model_cfg=cfg, params=params, tokenizer=tok)
+    port = next(_PORTS)
+    ready, stop = threading.Event(), threading.Event()
+    threading.Thread(target=serve,
+                     args=(state, "127.0.0.1", port, ready, stop),
+                     daemon=True).start()
+    assert ready.wait(10)
+    addr = f"127.0.0.1:{port}"
+    pool = BackendPool(addr)
+    poll_stop = threading.Event()
+    start_load_poller(pool, interval_s=0.2, stop=poll_stop)
+    try:
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            ent = pool.fleet().get(addr, {})
+            if ent.get("health"):
+                break
+            time.sleep(0.05)
+        ent = pool.fleet()[addr]
+        assert ent["health"]["status"] == "ok"
+        # the poller's /healthz sample carries the whole dashboard payload
+        assert "slo" in ent["health"] and "flight" in ent["health"]
+        assert "load" in ent and ent["health_age_s"] < 5.0
+
+        # routerless mode scrapes the replica directly into the same shape
+        direct = tputop.fetch_replicas([addr])
+        assert direct["replicas"][addr]["health"]["status"] == "ok"
+
+        # the router serves the aggregation; tputop renders it
+        from http.server import ThreadingHTTPServer
+
+        from aws_k8s_ansible_provisioner_tpu.serving.router import (
+            RouterHandler, RouterMetrics)
+        old = RouterHandler.pool, RouterHandler.metrics
+        RouterHandler.pool = pool
+        RouterHandler.metrics = RouterMetrics()
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            fleet = tputop.fetch_fleet(
+                f"http://127.0.0.1:{srv.server_port}")
+            assert fleet["backends"] == [addr]
+            assert fleet["replicas"][addr]["health"]["status"] == "ok"
+            frame = tputop.render(fleet)
+            assert "1 replica," in frame and addr in frame
+            assert "SLO ok" in frame
+        finally:
+            srv.shutdown()
+            RouterHandler.pool, RouterHandler.metrics = old
+    finally:
+        poll_stop.set()
+        stop.set()
+        time.sleep(0.1)

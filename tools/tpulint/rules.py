@@ -1,4 +1,4 @@
-"""tpulint rules R1-R8. Each rule is a pure function Project -> [Finding].
+"""tpulint rules R1-R9. Each rule is a pure function Project -> [Finding].
 
 These are PROJECT-NATIVE rules: they encode this repo's concurrency and
 observability contracts, not generic style. Where a rule is necessarily
@@ -760,6 +760,77 @@ def r8_decode_blocking(project: Project) -> List[Finding]:
                 "decode dispatch path must not synchronize with the device "
                 "(it re-serializes the pipeline); defer the read to the "
                 "sanctioned fetch helper _decode_fetch"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R9: anomalous terminal edges must hit the flight recorder
+# ---------------------------------------------------------------------------
+
+_R9_OK_REASONS = {"stop", "length", ""}
+
+
+def _r9_anomalous_edges(tree: ast.AST):
+    """Yield (node, ancestors, description) for each anomalous terminal
+    edge: a ``<x>.finish_reason = "<reason>"`` assignment whose constant
+    reason is outside the healthy set (stop/length/empty), or a
+    ``requests_shed.inc(...)`` counter bump (shed is terminal for the
+    request even though no request object ever exists)."""
+    for node, ancestors in _walk_with_stack(tree):
+        if isinstance(node, ast.Assign):
+            if (isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                    and node.value.value not in _R9_OK_REASONS
+                    and any(isinstance(t, ast.Attribute)
+                            and t.attr == "finish_reason"
+                            for t in node.targets)):
+                yield (node, ancestors,
+                       f'finish_reason = "{node.value.value}"')
+        elif isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain[-2:] == ["requests_shed", "inc"]:
+                yield node, ancestors, "requests_shed.inc(...)"
+
+
+def _r9_has_flight_call(fn: ast.AST) -> bool:
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            if any("flight" in seg.lower()
+                   for seg in attr_chain(sub.func)):
+                return True
+    return False
+
+
+@rule("R9", "anomalous terminal edges must hit the flight recorder")
+def r9_flight_coverage(project: Project) -> List[Finding]:
+    """The flight recorder (serving/flightrec.py) is only worth trusting if
+    EVERY abnormal way a request can end leaves a timeline event — a dump
+    with a missing edge reads as "nothing happened here", which is worse
+    than no dump. Approximation: an *anomalous terminal edge* is (a) an
+    assignment of a constant ``finish_reason`` outside stop/length/empty
+    (error, timeout, cancelled, preempted, ...), or (b) a
+    ``requests_shed.inc(...)`` bump. The function containing such an edge
+    must somewhere call into the recorder — any call whose attribute chain
+    mentions a ``flight`` segment (``flightrec.record``, ``_flight.finish``,
+    ``self._flight_note``) counts; the edge and the recording need not be
+    adjacent statements because finish-path helpers batch them. Dynamic
+    reasons (``finish_reason = reason``) are invisible to this rule by
+    design — the assigning function is then a generic finisher whose
+    callers carry the classification. A reasoned
+    ``# tpulint: disable=R9`` pragma escapes (e.g. a reason that is
+    re-assigned, not originated, on that line)."""
+    out: List[Finding] = []
+    for f in project.serving_files():
+        for node, ancestors, desc in _r9_anomalous_edges(f.tree):
+            encl = _enclosing_funcdef(ancestors)
+            if encl is None or _r9_has_flight_call(encl):
+                continue
+            out.append(Finding(
+                "R9", f.rel, node.lineno,
+                f"anomalous terminal edge {desc} in '{encl.name}' without "
+                "a flight-recorder event — this request would end with no "
+                "black-box timeline; record the edge (flightrec.record/"
+                "finish) or carry a reasoned pragma"))
     return out
 
 

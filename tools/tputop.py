@@ -1,0 +1,179 @@
+"""tpu-top: refresh-in-place fleet dashboard for the serving stack.
+
+``python -m tools.tputop --router host:8080`` renders one row per engine
+replica — throughput, queue pressure, KV-pool occupancy, host-bubble share,
+SLO burn rates, and the flight recorder's last anomaly — from the router's
+``/debug/fleet`` aggregation (one round trip per refresh; the router's ~1 Hz
+poller already holds every replica's last /load + /healthz sample).
+
+``--replicas host:8000,host:8001`` bypasses the router and scrapes each
+replica's ``/healthz`` directly (single-replica dev loops, kind rehearsals).
+
+stdlib-only (urllib + ANSI), same as the router: the dashboard must run from
+any pod or operator laptop with nothing but the framework image's python.
+``render(fleet)`` is a pure function of the fleet dict so tests assert exact
+frames without sockets.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+COLUMNS = ("replica", "st", "tok/s", "act", "que", "pages", "bub%",
+           "burn5m", "last anomaly")
+
+# worst 5m burn >= this renders as BURNING in the header (the Google-SRE
+# "burning exactly the budget" line; the page-now threshold is 14.4)
+BURN_WARN = 1.0
+
+
+def fetch_fleet(router_url: str, timeout: float = 5.0) -> dict:
+    """GET the router's /debug/fleet aggregation."""
+    with urllib.request.urlopen(router_url.rstrip("/") + "/debug/fleet",
+                                timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def fetch_replicas(addrs: list, timeout: float = 5.0) -> dict:
+    """Routerless mode: scrape each replica's /healthz into the same fleet
+    shape /debug/fleet serves (errors become a row with no health sample)."""
+    replicas = {}
+    for addr in addrs:
+        ent: dict = {"cooling": False, "draining": False}
+        try:
+            with urllib.request.urlopen(f"http://{addr}/healthz",
+                                        timeout=timeout) as r:
+                h = json.loads(r.read())
+            if isinstance(h, dict):
+                ent["health"] = h
+                ent["health_age_s"] = 0.0
+                ent["draining"] = bool(h.get("draining"))
+        except (urllib.error.URLError, OSError, ValueError):
+            pass
+        replicas[addr] = ent
+    return {"backends": list(addrs), "cooling_down": [], "draining": [],
+            "replicas": replicas}
+
+
+def _worst_burn(slo: dict) -> tuple:
+    """(worst 5m burn, objective name) over a /healthz slo snapshot."""
+    worst, name = 0.0, ""
+    for obj, d in (slo or {}).items():
+        try:
+            b = float(d.get("5m", 0.0))
+        except (TypeError, AttributeError, ValueError):
+            continue
+        if b > worst:
+            worst, name = b, obj
+    return worst, name
+
+
+def _row(addr: str, ent: dict) -> list:
+    h = ent.get("health") or {}
+    status = h.get("status", "?")
+    if ent.get("cooling"):
+        status = "dead?"
+    elif ent.get("draining"):
+        status = "drain"
+    tok = h.get("tokens_per_second")
+    act = h.get("active_requests")
+    que = h.get("queue_depth")
+    pages_t = h.get("kv_pages_total") or 0
+    pages_u = h.get("kv_pages_in_use") or 0
+    pages = f"{pages_u}/{pages_t}" if pages_t else "-"
+    bub = h.get("decode_bubble_pct")
+    burn, obj = _worst_burn(h.get("slo"))
+    anomaly = "-"
+    last = (h.get("flight") or {}).get("last_anomaly")
+    if isinstance(last, dict):
+        anomaly = f"{last.get('reason', '?')} {last.get('request_id', '')}" \
+            .strip()[:28]
+    return [addr, status[:6],
+            "-" if tok is None else f"{tok:.1f}",
+            "-" if act is None else str(act),
+            "-" if que is None else str(que),
+            pages,
+            "-" if bub is None else f"{bub:.1f}",
+            f"{burn:.2f}" + (f" {obj}" if obj and burn >= BURN_WARN else ""),
+            anomaly]
+
+
+def render(fleet: dict) -> str:
+    """One dashboard frame from a /debug/fleet dict — pure, testable."""
+    replicas = fleet.get("replicas") or {}
+    rows = [_row(addr, replicas[addr] or {}) for addr in sorted(replicas)]
+    widths = [len(c) for c in COLUMNS]
+    for r in rows:
+        widths = [max(w, len(str(v))) for w, v in zip(widths, r)]
+    sep = "  "
+    lines = []
+    n = len(rows)
+    burning = [r[0] for r in rows
+               if r[7] and float(r[7].split()[0]) >= BURN_WARN]
+    head = f"tpu-top — {n} replica{'s' if n != 1 else ''}"
+    if fleet.get("draining"):
+        head += f", {len(fleet['draining'])} draining"
+    if fleet.get("cooling_down"):
+        head += f", {len(fleet['cooling_down'])} cooling"
+    head += f", SLO {'BURNING: ' + ', '.join(burning) if burning else 'ok'}"
+    lines.append(head)
+    lines.append(sep.join(c.ljust(w) for c, w in zip(COLUMNS, widths)))
+    for r in rows:
+        lines.append(sep.join(str(v).ljust(w) for v, w in zip(r, widths)))
+    if not rows:
+        lines.append("(no replicas)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools.tputop",
+        description="fleet dashboard: replicas x {throughput, queue, pages, "
+                    "bubble, SLO burn, last anomaly}")
+    p.add_argument("--router", default="",
+                   help="router base URL or host:port (reads /debug/fleet)")
+    p.add_argument("--replicas", default="",
+                   help="comma-separated engine host:port list to scrape "
+                        "directly (bypasses the router)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh seconds (watch mode)")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (scripting/tests)")
+    args = p.parse_args(argv)
+    if not args.router and not args.replicas:
+        p.error("one of --router or --replicas is required")
+
+    def frame() -> str:
+        if args.replicas:
+            return render(fetch_replicas(
+                [a.strip() for a in args.replicas.split(",") if a.strip()]))
+        url = args.router
+        if "://" not in url:
+            url = "http://" + url
+        return render(fetch_fleet(url))
+
+    if args.once:
+        print(frame())
+        return 0
+    try:
+        while True:
+            try:
+                out = frame()
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                out = f"tpu-top — fetch failed: {e}"
+            # clear + home, then the frame (refresh-in-place)
+            sys.stdout.write("\x1b[2J\x1b[H" + out + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
