@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests \
 	overload-smoke resume-smoke reconcile-smoke trace-smoke lint \
-	locksan-smoke aot-smoke pipeline-smoke flight-smoke
+	locksan-smoke aot-smoke pipeline-smoke flight-smoke devmon-smoke
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -141,6 +141,14 @@ aot-smoke:
 # by a request. Tier-1 runs the same tests (marker flight_smoke).
 flight-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m flight_smoke \
+		-p no:cacheprovider
+
+# Device-telemetry smoke (serving/devmon.py): golden /debug/roofline
+# arithmetic under a fake clock, HBM drift warn-never-kill, byte-identical
+# streams devmon on/off, OpenMetrics exemplar/escaping goldens. Tier-1 runs
+# the same tests (marker devmon_smoke).
+devmon-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m devmon_smoke \
 		-p no:cacheprovider
 
 # Full bench field-plumbing proof on CPU (tiny model, ~15 s): one JSON line

@@ -755,6 +755,18 @@ class ServingConfig:
     # Empty = in-memory snapshots only (/debug/flight/<id> still serves the
     # recent ones). serving.yaml.j2 backs it with the pod's emptyDir.
     flight_spool_dir: str = ""
+    # ---- Device telemetry (serving/devmon.py) ----
+    # Roofline peaks the MFU/bandwidth gauges divide by. Defaults are the
+    # v5e per-chip numbers from PERF.md (bf16 peak, HBM bandwidth); set them
+    # per accelerator generation in group_vars (serving.yaml.j2 threads
+    # --devmon-peak-tflops / --devmon-peak-hbm-gbps).
+    devmon_enabled: bool = True
+    devmon_peak_tflops: float = 197.0
+    devmon_peak_hbm_gbps: float = 819.0
+    # Live-vs-compiled HBM drift tolerance (MB): the /healthz verdict flips
+    # to "warn" (never kills) when live occupancy exceeds the AOT ledger by
+    # more than this.
+    devmon_hbm_tolerance_mb: float = 64.0
     # Seed for the engine's DERIVED sampling seeds (requests without an
     # OpenAI ``seed``). None = entropy from os.urandom at engine start, so
     # restarts and replicas draw independently (the vLLM/OpenAI
@@ -905,6 +917,11 @@ def ansible_vars(cfg: FrameworkConfig | None = None,
     d["serving_slo_error_rate"] = cfg.serving.slo_error_rate
     d["serving_flight_spool_dir"] = (cfg.serving.flight_spool_dir
                                      or "/tmp/tpu-serve-flight")
+    # Device telemetry roofline peaks (serving/devmon.py): the manifest
+    # threads these to --devmon-peak-tflops / --devmon-peak-hbm-gbps so the
+    # tpu_device_* gauges divide by the right ceilings per TPU generation.
+    d["serving_devmon_peak_tflops"] = cfg.serving.devmon_peak_tflops
+    d["serving_devmon_peak_hbm_gbps"] = cfg.serving.devmon_peak_hbm_gbps
     # --set overrides (rehearsals pin model/ports); unknown keys pass
     # through — the playbooks treat group_vars as an open namespace
     d.update(overrides or {})

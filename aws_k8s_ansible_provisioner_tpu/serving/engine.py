@@ -43,6 +43,7 @@ import numpy as np
 
 from aws_k8s_ansible_provisioner_tpu.config import ModelConfig, ServingConfig
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
+from aws_k8s_ansible_provisioner_tpu.serving import devmon as _devmon
 from aws_k8s_ansible_provisioner_tpu.serving import flightrec as _flight
 from aws_k8s_ansible_provisioner_tpu.serving import slo as _slo
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import EngineMetrics
@@ -63,6 +64,18 @@ from aws_k8s_ansible_provisioner_tpu.serving.programs import (  # noqa: F401
 )
 
 _REQUEST_IDS = itertools.count()
+
+
+def _tree_bytes(tree) -> int:
+    """HBM bytes of a pytree of device/host arrays, from shape/dtype
+    metadata only — never a device transfer (safe on any thread)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        try:
+            total += int(leaf.size) * int(np.dtype(leaf.dtype).itemsize)
+        except (TypeError, ValueError, AttributeError):
+            pass
+    return total
 
 
 class ContextLengthExceeded(ValueError):
@@ -173,6 +186,10 @@ class Request:
     resume_ids: tuple = ()
     # absolute time.monotonic() deadline, resolved at submit (0.0 = none)
     t_deadline: float = 0.0
+    # root-span trace id the server bound to this request (empty = tracing
+    # off) — feeds the OpenMetrics exemplars on the ttft/request-duration
+    # histogram buckets so a burning bucket links straight to its trace
+    trace_id: str = ""
     id: int = field(default_factory=lambda: next(_REQUEST_IDS))
     # Filled in by the engine:
     generated: List[int] = field(default_factory=list)
@@ -401,6 +418,43 @@ class Engine(EnginePrograms):
         # double-count device_busy_seconds.
         self._last_ready = 0.0
         self._busy_watermark = 0.0
+        # Device telemetry (serving/devmon.py): hand the monitor the
+        # analytical cost model and the host-metadata HBM samplers. Pure
+        # wiring — recording happens at the programs.py busy sites, and the
+        # samplers never touch the device (sizes/dtypes are host metadata).
+        self._install_devmon()
+
+    def _install_devmon(self):
+        mon = _devmon.get()
+        params_bytes = _tree_bytes(self.params)
+        mon.install_cost_model(_devmon.CostModel.from_config(
+            self.cfg, kv_dtype=self.serving.kv_dtype,
+            weight_bytes=params_bytes))
+        cache_bytes = _tree_bytes(self.cache)
+
+        def _live() -> dict:
+            comp = {"params": float(params_bytes)}
+            if self.paged:
+                sts = [a.stats() for a in self.allocators]
+                total = sum(s["pages_total"] for s in sts) or 1
+                live = sum(s["pages_live"] for s in sts)
+                comp["kv_pages"] = cache_bytes * (live / total)
+            else:
+                comp["kv_cache"] = float(cache_bytes)
+            carry = self._pipe_carry
+            if carry is not None:
+                comp["sampler_carry"] = float(
+                    _tree_bytes((carry[0], carry[1])))
+            if self._op_cache:
+                comp["operand_cache"] = float(
+                    _tree_bytes(tuple(self._op_cache.values())))
+            return comp
+
+        def _compiled() -> float:
+            aot = self.aot
+            return float(aot["hbm_total_bytes"]) if aot else 0.0
+
+        mon.install_hbm(_live, _compiled)
 
 
     @staticmethod
@@ -1274,7 +1328,8 @@ class Engine(EnginePrograms):
         req.t_done = time.monotonic()
         status = ("success" if req.finish_reason in ("stop", "length")
                   else req.finish_reason or "success")
-        self.metrics.mark_request(status, req.t_done - req.t_submit)
+        self.metrics.mark_request(status, req.t_done - req.t_submit,
+                                  trace_id=req.trace_id or None)
         # Terminal flight event: OK finishes free the timeline; anomalous
         # ones (timeout/error/cancelled) snapshot it for /debug/flight and
         # the spool (drop-on-overflow — never blocks this thread).

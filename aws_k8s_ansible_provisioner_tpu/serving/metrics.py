@@ -7,6 +7,15 @@ queries ``vllm_request_total``-style counters and duration histogram buckets
 (``:754-761``). We emit the same *shapes* under the ``tpu_serve_`` prefix plus
 vllm-compatible aliases so the unchanged dashboards/cookbook keep working
 (SURVEY.md §7 capability contract item 6).
+
+Both exposition formats are supported from the same registries: classic
+Prometheus text (``text/plain; version=0.0.4``, the default) and OpenMetrics
+(``application/openmetrics-text``) when the scraper's Accept header asks for
+it. OpenMetrics mode adds exemplars to histogram *bucket* lines only — the
+``# {trace_id="..."} v`` tail that lets Grafana jump from a burning latency
+bucket straight to the Tempo trace (and from there to the flight dump). The
+route handler appends the single ``# EOF`` terminator after concatenating
+every registry; ``render()`` never writes it so registries stay composable.
 """
 
 from __future__ import annotations
@@ -34,8 +43,13 @@ class Counter:
         with self._lock:
             return sum(self._values.values())
 
-    def collect(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+    def collect(self, openmetrics: bool = False) -> List[str]:
+        # OpenMetrics names the counter FAMILY without the _total suffix
+        # (samples keep it); classic text uses the full name everywhere.
+        fam = self.name
+        if openmetrics and fam.endswith("_total"):
+            fam = fam[:-len("_total")]
+        out = [f"# HELP {fam} {self.help}", f"# TYPE {fam} counter"]
         for key, val in sorted(self._values.items()):
             out.append(f"{self.name}{_fmt_labels(key)} {val}")
         if not self._values:
@@ -70,7 +84,7 @@ class Gauge:
         with self._lock:
             return self._values.get(key, 0.0)
 
-    def collect(self) -> List[str]:
+    def collect(self, openmetrics: bool = False) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} gauge"]
         with self._lock:
@@ -92,33 +106,63 @@ class Histogram:
         self.name, self.help = name, help_
         self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
         self._counts = [0] * (len(self.buckets) + 1)
+        # last exemplar per bucket (incl +Inf): (trace_id, observed value).
+        # One slot per bucket — "most recent wins", the standard client
+        # behavior; rendered only in OpenMetrics mode, on bucket lines only.
+        self._exemplars: List[Optional[Tuple[str, float]]] = \
+            [None] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._total = 0
         self._lock = threading.Lock()
 
-    def observe(self, v: float):
+    def observe(self, v: float, trace_id: Optional[str] = None):
         with self._lock:
             self._sum += v
             self._total += 1
+            placed = False
             for i, b in enumerate(self.buckets):
                 if v <= b:
                     self._counts[i] += 1
+                    if trace_id and not placed:
+                        # exemplar lives on the LOWEST bucket containing
+                        # the observation (where it "falls")
+                        self._exemplars[i] = (str(trace_id), v)
+                        placed = True
             self._counts[-1] += 1  # +Inf
+            if trace_id and not placed:
+                self._exemplars[-1] = (str(trace_id), v)
 
-    def collect(self) -> List[str]:
+    def _exemplar_tail(self, i: int, openmetrics: bool) -> str:
+        ex = self._exemplars[i]
+        if not openmetrics or ex is None:
+            return ""
+        tid, v = ex
+        return f' # {{trace_id="{_escape_label_value(tid)}"}} {v}'
+
+    def collect(self, openmetrics: bool = False) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         for i, b in enumerate(self.buckets):
-            out.append(f'{self.name}_bucket{{le="{b}"}} {self._counts[i]}')
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {self._counts[-1]}')
+            out.append(f'{self.name}_bucket{{le="{b}"}} {self._counts[i]}'
+                       + self._exemplar_tail(i, openmetrics))
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self._counts[-1]}'
+                   + self._exemplar_tail(len(self.buckets), openmetrics))
         out.append(f"{self.name}_sum {self._sum}")
         out.append(f"{self.name}_count {self._total}")
         return out
 
 
+def _escape_label_value(v) -> str:
+    """Exposition-format label-value escaping (shared by both formats):
+    backslash, double-quote, and line-feed must be escaped or a crafted
+    value (a model name, a trace id) corrupts the whole scrape."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(key: _LabelKey) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 
@@ -132,11 +176,11 @@ class Registry:
             self._metrics.append(m)
         return m
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
         lines: List[str] = []
         with self._lock:
             for m in self._metrics:
-                lines.extend(m.collect())
+                lines.extend(m.collect(openmetrics))
         return "\n".join(lines) + "\n"
 
 
@@ -266,11 +310,12 @@ class EngineMetrics:
             "1 while the engine is draining (new admissions shed with "
             "reason=draining)"))
 
-    def mark_request(self, status: str, duration_s: float):
+    def mark_request(self, status: str, duration_s: float,
+                     trace_id: Optional[str] = None):
         self.request_total.inc(status=status)
         self.vllm_request_total.inc(status=status)
-        self.request_duration.observe(duration_s)
-        self.vllm_request_duration.observe(duration_s)
+        self.request_duration.observe(duration_s, trace_id=trace_id)
+        self.vllm_request_duration.observe(duration_s, trace_id=trace_id)
         # Every terminal edge already funnels through here — feed the SLO
         # burn-rate engine from the same single point (serving/slo.py; the
         # deferred import breaks the metrics <- slo module cycle and costs a

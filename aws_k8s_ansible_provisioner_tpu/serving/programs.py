@@ -53,6 +53,7 @@ from aws_k8s_ansible_provisioner_tpu.ops.sampling import (apply_penalties,
                                                            per_slot_keys,
                                                            sample)
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
+from aws_k8s_ansible_provisioner_tpu.serving import devmon as _devmon
 from aws_k8s_ansible_provisioner_tpu.serving import flightrec as _flight
 from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
 from aws_k8s_ansible_provisioner_tpu.serving import slo as _slo
@@ -1077,7 +1078,8 @@ class EnginePrograms:
         now = time.monotonic()
         if not req.t_first_token:     # don't re-observe on preemption resume
             req.t_first_token = now
-            self.metrics.ttft.observe(now - req.t_submit)
+            self.metrics.ttft.observe(now - req.t_submit,
+                                      trace_id=req.trace_id or None)
             _slo.get().observe_ttft(now - req.t_submit)
         _flight.record("admit", req.id, slot=slot, resumed=resumed,
                        queue_wait_s=round(max(0.0, (req.t_prefill_start
@@ -1207,7 +1209,9 @@ class EnginePrograms:
         if req.prompt_logprobs is not None:
             self._host_prompt_lp(req, items[pos], 0, len(ids))
         token = int(token)  # device sync
-        self.metrics.device_busy_seconds.inc(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self.metrics.device_busy_seconds.inc(dt)
+        _devmon.note("prefill", dt, batch=1, tokens=len(ids))
         if self.draft is not None:
             self.draft.prefill(self, tokens, np.asarray([len(ids)], np.int32),
                                np.asarray([slot], np.int32))
@@ -1293,7 +1297,10 @@ class EnginePrograms:
         plp_t = tuple(np.asarray(a) for a in items[pos]) \
             if want_plp else None                        # ONE bulk transfer
         toks = np.asarray(toks)  # device sync
-        self.metrics.device_busy_seconds.inc(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self.metrics.device_busy_seconds.inc(dt)
+        _devmon.note("prefill_batch", dt, batch=len(batch),
+                     tokens=int(true_lens.sum()))
         if self.draft is not None:
             self.draft.prefill(self, tokens, true_lens, slots)
         for i, (req, slot) in enumerate(batch):
@@ -1347,7 +1354,9 @@ class EnginePrograms:
                 # unsynced window would record ~0 busy time for the device
                 # work this feature adds
                 jax.block_until_ready(self.cache["k"])
-                self.metrics.device_busy_seconds.inc(time.monotonic() - t0)
+                dt = time.monotonic() - t0
+                self.metrics.device_busy_seconds.inc(dt)
+                _devmon.note("prefix_copy", dt, tokens=n)
             off = n
             self.metrics.prefix_cache_hits.inc()
             self.metrics.prefix_tokens_reused.inc(n)
@@ -1412,7 +1421,9 @@ class EnginePrograms:
             self.metrics.mark_request("error", 0.0)
             req.out_queue.put(None)
             raise
-        self.metrics.device_busy_seconds.inc(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self.metrics.device_busy_seconds.inc(dt)
+        _devmon.note("prefill_chunk", dt, tokens=len(chunk))
         st["off"] = off + len(chunk)
         # Interleaved decode dispatches write a (garbage) k/v row for every
         # slot at its host length; keeping this slot's length at the chunk
@@ -1515,6 +1526,10 @@ class EnginePrograms:
         accepted = np.asarray(accepted)
         dt = time.monotonic() - t0
         self.metrics.device_busy_seconds.inc(dt)
+        _devmon.note("spec_decode", dt, batch=len(active),
+                     tokens=R * len(active),
+                     ctx_rows=float(np.mean(self.lengths[list(active)]))
+                     if active else 0.0)
         emitted = 0
         for slot in active:
             if slot in skip:
@@ -1590,6 +1605,23 @@ class EnginePrograms:
         self._pipe_carry = None
         self.metrics.pipeline_depth.set(0.0)
         self._decode_fetch(rec, tail=True)
+
+    @staticmethod
+    def _donatable(mirror: np.ndarray):
+        """Device upload of a host mirror that is SAFE to pass in a donated
+        argument position.
+
+        ``jnp.asarray`` of an aligned numpy array is zero-copy on the CPU
+        backend — the jax.Array is a *view of the engine's mirror buffer*.
+        ``decode_steps`` donates its token/length carry, so XLA may alias
+        that buffer for an output and write the final device-side lengths
+        straight into ``self.lengths``: the mirror then advances once in
+        place by the kernel and again (+1/token) by the emit loop, and the
+        double-counted rows exhaust the cache window at half budget with a
+        premature "length" finish. Copying first hands the device a buffer
+        nothing else references, which donation may then consume freely.
+        """
+        return jnp.asarray(np.array(mirror))
 
     def _decode_operands(self):
         """Device-resident sampling/table operands for decode dispatches.
@@ -1738,8 +1770,8 @@ class EnginePrograms:
             # feed dispatch N+1 directly (donated) — no host round-trip
             tok_in, len_in = self._pipe_carry[0], self._pipe_carry[1]
         else:
-            tok_in = jnp.asarray(self.last_token)
-            len_in = jnp.asarray(self.lengths)
+            tok_in = self._donatable(self.last_token)
+            len_in = self._donatable(self.lengths)
         rec = self._decode_dispatch(horizon, active, gset, gslots, want_lp,
                                     want_pen, tok_in, len_in)
         if self._pipeline_on() and not gset:
@@ -1858,6 +1890,11 @@ class EnginePrograms:
         self._busy_watermark = t_ready
         self.metrics.device_busy_seconds.inc(dev_dt)
         self.metrics.decode_step_duration.observe(dev_dt / horizon)
+        _devmon.note("decode", dev_dt, batch=len(rec["active"]),
+                     tokens=horizon * len(rec["active"]),
+                     ctx_rows=float(np.mean(self.lengths[
+                         list(rec["active"])])) if rec["active"] else 0.0,
+                     steps=horizon)
         gset = rec["gset"]
         emitted = 0
         for s in range(horizon):
@@ -2017,7 +2054,8 @@ class EnginePrograms:
             if horizon > 1:
                 self.cache, _, _, _, _ = decode_steps(
                     self.cfg, horizon, self.params, self.cache,
-                    jnp.asarray(self.last_token), jnp.asarray(self.lengths),
+                    self._donatable(self.last_token),
+                    self._donatable(self.lengths),
                     self._next_rng(), jnp.asarray(self.temps),
                     jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
                     mesh=self.mesh, impl=self.serving.attention_impl,
@@ -2103,7 +2141,7 @@ class EnginePrograms:
         mask = jnp.zeros((self.num_slots, self.cfg.vocab_size), jnp.bool_)
         self.cache, _, _, _, _ = decode_steps(
             self.cfg, horizon, self.params, self.cache,
-            jnp.asarray(self.last_token), jnp.asarray(self.lengths),
+            self._donatable(self.last_token), self._donatable(self.lengths),
             self._next_rng(), jnp.asarray(self.temps),
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
             mesh=self.mesh, impl=self.serving.attention_impl,
@@ -2146,7 +2184,7 @@ class EnginePrograms:
         # overwritten by real prefills.
         self.cache, _, _, _, _ = decode_steps(
             self.cfg, 1, self.params, self.cache,
-            jnp.asarray(self.last_token), jnp.asarray(self.lengths),
+            self._donatable(self.last_token), self._donatable(self.lengths),
             self._next_rng(), jnp.asarray(self.temps),
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
             mesh=self.mesh, impl=self.serving.attention_impl,

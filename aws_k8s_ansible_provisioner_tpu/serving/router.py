@@ -47,7 +47,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
-from aws_k8s_ansible_provisioner_tpu.serving import flightrec, slo, tracing
+from aws_k8s_ansible_provisioner_tpu.serving import (devmon, flightrec, slo,
+                                                     tracing)
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import (
     Counter, Gauge, Registry)
 
@@ -679,12 +680,23 @@ class RouterHandler(BaseHTTPRequestHandler):
             # process they carry the GATEWAY's view (its own process has no
             # engine, so burn gauges stay at their exported defaults).
             slo.get().export()
-            body = (self.metrics.registry.render()
-                    + tracing.metrics.registry.render()
-                    + flightrec.metrics.registry.render()
-                    + slo.metrics.registry.render()).encode()
+            devmon.get().export()
+            om = "application/openmetrics-text" in \
+                (self.headers.get("Accept") or "")
+            text = (self.metrics.registry.render(om)
+                    + tracing.metrics.registry.render(om)
+                    + flightrec.metrics.registry.render(om)
+                    + slo.metrics.registry.render(om)
+                    + devmon.metrics.registry.render(om))
+            if om:
+                text += "# EOF\n"
+                ctype = ("application/openmetrics-text; version=1.0.0; "
+                         "charset=utf-8")
+            else:
+                ctype = "text/plain; version=0.0.4"
+            body = text.encode()
             self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

@@ -28,7 +28,8 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
-from aws_k8s_ansible_provisioner_tpu.serving import flightrec, slo, tracing
+from aws_k8s_ansible_provisioner_tpu.serving import (devmon, flightrec, slo,
+                                                     tracing)
 from aws_k8s_ansible_provisioner_tpu.serving.engine import (
     ContextLengthExceeded, EngineOverloaded)
 
@@ -55,6 +56,25 @@ def _bubble_pct(eng) -> Optional[float]:
     if bubble + busy <= 0:
         return None
     return round(100.0 * bubble / (bubble + busy), 2)
+
+
+def _device_health() -> dict:
+    """Compact device block for /healthz (the fleet poller relays it to
+    /debug/fleet and tputop): HBM occupancy + drift verdict, duty cycle,
+    and the decode program's MFU. Full table lives at /debug/roofline."""
+    snap = devmon.get().snapshot()
+    hbm = snap["hbm"]
+    dec = snap["programs"].get("decode") or {}
+    return {
+        "hbm_drift": hbm["verdict"],
+        "hbm_live_bytes": int(hbm["live_bytes"]),
+        "hbm_compiled_bytes": int(hbm["compiled_bytes"]),
+        "hbm_drift_bytes": int(hbm["drift_bytes"]),
+        "duty_cycle": round(snap["duty_cycle"], 4),
+        "mfu": round(dec.get("mfu", 0.0), 4),
+        "membw_util": round(dec.get("membw_util", 0.0), 4),
+        "dma_wait_fraction": round(snap["dma_wait_fraction"], 4),
+    }
 
 
 class _NotifyQueue(queue.Queue):
@@ -312,13 +332,26 @@ class Handler(BaseHTTPRequestHandler):
                 render_engine_chips)
 
             slo.get().export()      # refresh the burn-rate gauges
-            body = (self.state.engine.metrics.registry.render()
-                    + tracing.metrics.registry.render()
-                    + flightrec.metrics.registry.render()
-                    + slo.metrics.registry.render()
-                    + render_engine_chips()).encode()
+            devmon.get().export()   # refresh the tpu_device_* family
+            # Content negotiation: OpenMetrics (exemplars + # EOF) when the
+            # scraper asks for it, classic Prometheus text otherwise.
+            om = "application/openmetrics-text" in \
+                (self.headers.get("Accept") or "")
+            text = (self.state.engine.metrics.registry.render(om)
+                    + tracing.metrics.registry.render(om)
+                    + flightrec.metrics.registry.render(om)
+                    + slo.metrics.registry.render(om)
+                    + devmon.metrics.registry.render(om)
+                    + render_engine_chips())
+            if om:
+                text += "# EOF\n"
+                ctype = ("application/openmetrics-text; version=1.0.0; "
+                         "charset=utf-8")
+            else:
+                ctype = "text/plain; version=0.0.4"
+            body = text.encode()
             self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -338,6 +371,7 @@ class Handler(BaseHTTPRequestHandler):
                 # keys off this to restart the pod (the engine thread cannot
                 # recover a hung XLA call itself)
                 status = "stalled"
+            dev = _device_health()
             self._json(503 if stalled else 200, {
                 "status": status,
                 "draining": bool(eng.draining),
@@ -393,6 +427,13 @@ class Handler(BaseHTTPRequestHandler):
                 "slo": slo.get().snapshot(),
                 "slo_burning": slo.get().burning(),
                 "flight": flightrec.get().summary(),
+                # Device panel (serving/devmon.py): HBM occupancy + drift
+                # verdict and the roofline headline numbers, for the
+                # router's fleet poller / tputop / probes.py L3. The drift
+                # verdict WARNS, never kills: a ledger miss is a diagnosis,
+                # not a liveness failure.
+                "device": dev,
+                "hbm_drift": dev["hbm_drift"],
             })
         elif path == "/readyz":
             # Readiness, distinct from liveness (r8): a DRAINING replica is
@@ -425,6 +466,12 @@ class Handler(BaseHTTPRequestHandler):
             self._admin_drain({})
         elif path == "/debug/profile":
             self._profile()
+        elif path == "/debug/roofline":
+            # Per-program roofline attribution table (serving/devmon.py):
+            # measured s/step vs the analytical floor, MFU, bandwidth
+            # utilization, dma-wait share, plus the live HBM ledger — the
+            # PERF.md model rendered against production traffic.
+            self._json(200, devmon.get().snapshot())
         elif path == "/debug/events":
             # the flight recorder's live ring, oldest first (?last=N caps it)
             import urllib.parse
@@ -954,6 +1001,9 @@ class Handler(BaseHTTPRequestHandler):
             # /debug/flight/<id> hands back the exact ids to paste into
             # Tempo beside the PR 5 phase spans
             for r in reqs:
+                # also onto the request itself: the engine's histogram
+                # observe points use it as the OpenMetrics exemplar
+                r.trace_id = self._trace_ctx.trace_id
                 flightrec.record("trace", r.id,
                                  trace_id=self._trace_ctx.trace_id,
                                  span_id=self._trace_ctx.span_id,
@@ -1577,6 +1627,13 @@ def build_state(serving_cfg=None, model_cfg=None, params=None,
     slo.configure(
         ttft_p95_ms=getattr(serving, "slo_ttft_p95_ms", 0.0),
         error_rate=getattr(serving, "slo_error_rate", 0.01))
+    # Device telemetry: configure() carries over the cost model + HBM
+    # samplers the engine installed during construction above.
+    devmon.configure(
+        enabled=getattr(serving, "devmon_enabled", True),
+        peak_tflops=getattr(serving, "devmon_peak_tflops", 197.0),
+        hbm_gbps=getattr(serving, "devmon_peak_hbm_gbps", 819.0),
+        hbm_tolerance_mb=getattr(serving, "devmon_hbm_tolerance_mb", 64.0))
     return state
 
 
@@ -1729,6 +1786,20 @@ def main(argv=None):
                    help="directory for the flight recorder's anomaly dump "
                         "spool (capped JSONL; rolled at 16 MiB); empty "
                         "keeps dumps in memory only (/debug/flight/<id>)")
+    p.add_argument("--devmon-peak-tflops", type=float, default=197.0,
+                   help="per-chip peak TFLOP/s the tpu_device_mfu gauges "
+                        "divide by (default: v5e bf16; set per TPU "
+                        "generation)")
+    p.add_argument("--devmon-peak-hbm-gbps", type=float, default=819.0,
+                   help="per-chip peak HBM GB/s the tpu_device_membw_util "
+                        "gauges divide by (default: v5e)")
+    p.add_argument("--devmon-hbm-tolerance-mb", type=float, default=64.0,
+                   help="live-vs-compiled HBM drift tolerance in MB before "
+                        "the /healthz hbm_drift verdict flips to 'warn' "
+                        "(warn-only; never fails probes)")
+    p.add_argument("--no-devmon", action="store_true",
+                   help="disable device telemetry recording (the "
+                        "tpu_device_* gauges freeze at their defaults)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--aot-manifest", default="",
                    help="AOT compile manifest (serving/aot.py) to adopt: "
@@ -1791,6 +1862,10 @@ def main(argv=None):
         slo_ttft_p95_ms=args.slo_ttft_p95_ms,
         slo_error_rate=args.slo_error_rate,
         flight_spool_dir=args.flight_spool_dir,
+        devmon_enabled=not args.no_devmon,
+        devmon_peak_tflops=args.devmon_peak_tflops,
+        devmon_peak_hbm_gbps=args.devmon_peak_hbm_gbps,
+        devmon_hbm_tolerance_mb=args.devmon_hbm_tolerance_mb,
         mesh=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep))
     state = build_state(serving)
     if args.aot_manifest:

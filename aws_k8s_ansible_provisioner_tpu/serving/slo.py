@@ -40,6 +40,17 @@ from aws_k8s_ansible_provisioner_tpu.serving.metrics import Gauge, Registry
 # (label, seconds) — the SRE fast/slow burn pair.
 WINDOWS: Tuple[Tuple[str, float], ...] = (("5m", 300.0), ("1h", 3600.0))
 
+
+def trim_window(dq, now: float, window_s: float) -> None:
+    """Drop samples older than ``now - window_s`` off a time-ordered deque
+    of ``(t, ...)`` tuples. The one trimming discipline every windowed
+    accumulator in serving/ shares (this engine's burn windows, devmon's
+    attribution window) — samples age out on WRITE and READ, so an idle
+    window drains to empty instead of freezing its last value."""
+    horizon = now - window_s
+    while dq and dq[0][0] < horizon:
+        dq.popleft()
+
 # Terminal statuses that burn the error budget ("cancelled" is the client
 # hanging up — their choice, not our failure).
 BAD_STATUSES = ("error", "timeout")
@@ -118,11 +129,9 @@ class SLOEngine:
         if dq is None:
             return
         now = self.clock()
-        horizon = now - WINDOWS[-1][1]
         with self._lock:
             dq.append((now, 1 if bad else 0))
-            while dq and dq[0][0] < horizon:
-                dq.popleft()
+            trim_window(dq, now, WINDOWS[-1][1])
 
     def observe_ttft(self, ttft_s: float):
         if not self.enabled:

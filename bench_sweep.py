@@ -110,9 +110,29 @@ def capture_profile_window(url: str, ms: int, timeout: float = 30.0):
         return {"error": str(e)[:200]}
 
 
+def capture_device_snapshot(url: str, timeout: float = 10.0):
+    """Capture one ``/debug/roofline`` attribution snapshot (serving/devmon.py:
+    per-program MFU / bandwidth-util / dma-wait plus the live-vs-compiled HBM
+    ledger) so the committed bench record carries the device-side explanation
+    of its own numbers. Returns the endpoint's JSON or ``{"error": ...}`` —
+    the bench must keep measuring either way."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + "/debug/roofline",
+                                    timeout=timeout) as r:
+            out = json.loads(r.read())
+            return out if isinstance(out, dict) else {"error": str(out)}
+    except urllib.error.HTTPError as e:
+        return {"error": f"/debug/roofline={e.code} {e.read()[:120]!r}"}
+    except (OSError, ValueError) as e:
+        return {"error": str(e)[:200]}
+
+
 def router_bench(n_streams: int, n_groups: int, n_replicas: int,
                  n_requests: int, out_path: str,
-                 profile_ms: int = 0) -> int:
+                 profile_ms: int = 0, device_snapshot: bool = False) -> int:
     """Drive the real router + real engine replicas with concurrent streams.
 
     Affinity design: requests belong to ``n_groups`` conversation groups
@@ -230,6 +250,12 @@ def router_bench(n_streams: int, n_groups: int, n_replicas: int,
         t.join()
     wall = time.monotonic() - t_start
 
+    dev_snap = None
+    if device_snapshot:
+        # read replica 0's roofline attribution BEFORE the failover leg
+        # kills it — the 60s devmon window still holds the whole run
+        dev_snap = capture_device_snapshot(f"http://127.0.0.1:{BASE}")
+
     hits1 = sum(_scrape_counter(BASE + i,
                                 "tpu_serve_prefix_cache_hits_total")
                 for i in range(n_replicas))
@@ -287,6 +313,8 @@ def router_bench(n_streams: int, n_groups: int, n_replicas: int,
         # "which config was slow" and "what the chip was doing" land in one
         # artifact instead of two terminals
         result["profile_window"] = profile
+    if dev_snap is not None:
+        result["device_snapshot"] = dev_snap
     with open(out_path, "w") as f:
         f.write(json.dumps(result, indent=1) + "\n")
     print(json.dumps(result))
@@ -294,7 +322,7 @@ def router_bench(n_streams: int, n_groups: int, n_replicas: int,
 
 
 def overload_bench(levels, n_replicas: int, n_requests: int,
-                   out_path: str) -> int:
+                   out_path: str, device_snapshot: bool = False) -> int:
     """Shed-rate-vs-offered-load curve through the REAL router (ROADMAP
     robustness follow-on; the overload analogue of ROUTER_BENCH).
 
@@ -412,6 +440,10 @@ def overload_bench(levels, n_replicas: int, n_requests: int,
         })
         sys.stderr.write(f"overload: conc={conc} -> {curve[-1]}\n")
 
+    dev_snap = None
+    if device_snapshot:
+        dev_snap = capture_device_snapshot(f"http://127.0.0.1:{BASE}")
+
     poll_stop.set()
     router.shutdown()
     for s in stops:
@@ -427,6 +459,8 @@ def overload_bench(levels, n_replicas: int, n_requests: int,
         "router_429_retries": int(m.retries_429.total()),
         "curve": curve,
     }
+    if dev_snap is not None:
+        result["device_snapshot"] = dev_snap
     with open(out_path, "w") as f:
         f.write(json.dumps(result, indent=1) + "\n")
     print(json.dumps(result))
@@ -460,6 +494,11 @@ def main() -> int:
                          "window trace of MS milliseconds from replica 0 "
                          "while the load is flowing; the trace path is "
                          "recorded in the sweep JSON (profile_window)")
+    ap.add_argument("--device-snapshot", action="store_true",
+                    help="router/overload modes: capture one /debug/roofline "
+                         "attribution snapshot (per-program MFU, bandwidth "
+                         "util, HBM ledger) from replica 0 and embed it in "
+                         "the bench artifact (device_snapshot)")
     ap.add_argument("--overload", action="store_true",
                     help="overload mode (CPU): drive offered load through "
                          "the router past the replicas' admission limits "
@@ -474,12 +513,14 @@ def main() -> int:
     if args.overload:
         levels = [int(x) for x in args.overload_levels.split(",") if x]
         return overload_bench(levels, args.overload_replicas,
-                              args.overload_requests, args.overload_out)
+                              args.overload_requests, args.overload_out,
+                              device_snapshot=args.device_snapshot)
     if args.router > 0:
         return router_bench(args.router, args.router_groups,
                             args.router_replicas, args.router_requests,
                             args.router_out,
-                            profile_ms=args.profile_window)
+                            profile_ms=args.profile_window,
+                            device_snapshot=args.device_snapshot)
     grid = parse_grid(args.grid) if args.grid \
         else (TTFT_GRID if args.ttft else DEFAULT_GRID)
     keys = sorted(grid)

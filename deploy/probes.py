@@ -223,23 +223,29 @@ def probe_l3(gv: Dict, inventory: Optional[str]) -> ProbeResult:
         return ProbeResult("L3", False, "no serving replicas discovered")
     bad = []
     burning = []
+    drifting = []
     threshold = _slo_burn_threshold()
     for addr in addrs:
         status, body = _http_get(f"http://{addr}/readyz")
         if status != 200:
             bad.append(f"{addr} /readyz={status} {body[:80]}")
-        if threshold is None:
-            continue
-        # SLO burn context (serving/slo.py, via /healthz): informational
-        # only — a replica over budget is SERVING, just badly, and the
-        # reconciler must not "repair" it into an outage. The detail tells
-        # the operator where to point tpu-top / the flight recorder.
+        # SLO burn + HBM drift context (serving/slo.py, serving/devmon.py,
+        # via /healthz): informational only — a replica over budget or
+        # past its compiled HBM ledger is SERVING, just suspiciously, and
+        # the reconciler must not "repair" it into an outage. The detail
+        # tells the operator where to point tpu-top / /debug/roofline /
+        # the flight recorder.
         h_status, h_body = _http_get(f"http://{addr}/healthz")
         if h_status != 200:
             continue
         try:
             h = json.loads(h_body)
         except ValueError:
+            continue
+        if h.get("hbm_drift") == "warn":
+            drift = (h.get("device") or {}).get("hbm_drift_bytes", 0)
+            drifting.append(f"{addr}:+{drift}B")
+        if threshold is None:
             continue
         for obj, d in sorted((h.get("slo") or {}).items()):
             try:
@@ -253,10 +259,12 @@ def probe_l3(gv: Dict, inventory: Optional[str]) -> ProbeResult:
     if threshold is not None:
         slo_detail = ", slo: " + (f"burning({', '.join(burning)})"
                                   if burning else "ok")
+    drift_detail = ", hbm_drift: " + (f"warn({', '.join(drifting)})"
+                                      if drifting else "ok")
     return ProbeResult("L3", not bad,
                        f"{len(addrs)} replica(s) "
                        + ("all ready" if not bad else "; ".join(bad))
-                       + slo_detail)
+                       + slo_detail + drift_detail)
 
 
 def gateway_addr(gv: Dict, inventory: Optional[str]) -> str:

@@ -150,6 +150,26 @@ def _validate_workload(d: dict, name: str):
                             "volumeMount covers that path — flight dumps "
                             "would die with the container (see "
                             "serving.yaml.j2 flight-spool)")
+        # Devmon scrape pairing (serving/devmon.py): a container launched
+        # with --devmon-* flags publishes the tpu_device_* family on its
+        # /metrics route, which only reaches Prometheus through the
+        # annotation-gated pod discovery (otel-observability-setup.yaml
+        # engine-metrics job). Flags without the scrape annotations are
+        # telemetry that renders but is never collected. (CLI acceptance of
+        # the flags themselves is the R7 cross-check below.)
+        if any(isinstance(a, str) and a.startswith("--devmon-")
+               for a in argv):
+            ann = ((tmpl.get("metadata") or {}).get("annotations")) or {}
+            if str(ann.get("prometheus.io/scrape")).lower() != "true":
+                _fail(name, f"{kind} {mname} container {c.get('name')} "
+                            "passes --devmon-* flags but the pod template "
+                            "has no prometheus.io/scrape=\"true\" "
+                            "annotation — the tpu_device_* family would "
+                            "never be scraped")
+            if not ann.get("prometheus.io/port"):
+                _fail(name, f"{kind} {mname} container {c.get('name')} "
+                            "passes --devmon-* flags but the pod template "
+                            "has no prometheus.io/port annotation")
         # Compile-cache pairing (AOT cold-start work, serving/aot.py): a
         # JAX_COMPILATION_CACHE_DIR env must point INSIDE a declared
         # volumeMount of the same container — a cache on the container's

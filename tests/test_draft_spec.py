@@ -74,7 +74,7 @@ def test_perfect_draft_full_acceptance_and_parity():
     accepted = eng.metrics.spec_accepted_tokens.total()
     assert drafted > 0
     assert accepted == drafted, "a self-draft must be fully accepted"
-    assert eng.metrics.spec_acceptance_rate._value == pytest.approx(1.0)
+    assert eng.metrics.spec_acceptance_rate.value() == pytest.approx(1.0)
 
 
 def test_divergent_draft_still_lossless():

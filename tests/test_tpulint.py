@@ -616,6 +616,85 @@ def test_r9_pragma_with_reason_suppresses(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# R10: tpu_device_* both-route rendering + single writer
+# ---------------------------------------------------------------------------
+
+
+_R10_BASE = {
+    "pkg/serving/devmon.py": """
+        class DevMonMetrics:
+            def __init__(self):
+                r = Registry()
+                self.registry = r
+                self.mfu = r.register(
+                    Gauge("tpu_device_mfu", "model flop util"))
+                self.duty = r.register(
+                    Gauge("tpu_device_duty_cycle", "busy share"))
+
+        metrics = DevMonMetrics()
+
+        class DevMon:
+            def export(self):
+                metrics.mfu.set(0.5)
+                metrics.duty.set(0.9)
+    """,
+    "pkg/serving/server.py": """
+        class Handler:
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = devmon.metrics.registry.render()
+    """,
+    "pkg/serving/router.py": """
+        class RHandler:
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = devmon.metrics.registry.render()
+    """,
+}
+
+
+def test_r10_clean_when_both_routes_render_and_one_writer(tmp_path):
+    assert _lint(tmp_path, _R10_BASE, only=["R10"]) == []
+
+
+def test_r10_fires_when_router_route_misses_device_set(tmp_path):
+    files = dict(_R10_BASE)
+    files["pkg/serving/router.py"] = """
+        class RHandler:
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = own.metrics.registry.render()
+    """
+    fs = _lint(tmp_path, files, only=["R10"])
+    assert _rules_of(fs) == ["R10"]
+    assert "router" in fs[0].message and "DevMonMetrics" in fs[0].message
+
+
+def test_r10_fires_on_second_writer_site(tmp_path):
+    files = dict(_R10_BASE)
+    files["pkg/serving/engine.py"] = """
+        class Engine:
+            def step(self):
+                devmon.metrics.mfu.set(0.1)
+    """
+    fs = _lint(tmp_path, files, only=["R10"])
+    assert _rules_of(fs) == ["R10"]
+    assert "'mfu'" in fs[0].message and "2 sites" in fs[0].message
+
+
+def test_r10_silent_when_no_device_metrics_exist(tmp_path):
+    fs = _lint(tmp_path, {"pkg/serving/metrics.py": """
+        class EngineMetrics:
+            def __init__(self):
+                r = Registry()
+                self.registry = r
+                self.requests = r.register(
+                    Counter("tpu_serve_requests_total", "n"))
+    """}, only=["R10"])
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
 # runner semantics
 # ---------------------------------------------------------------------------
 

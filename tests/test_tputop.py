@@ -102,6 +102,47 @@ def test_render_draining_and_subthreshold_burn():
     assert "0.40" in row and "error_rate" not in row
 
 
+def test_render_device_panel_golden_frame():
+    """The devmon columns (HBM bar, MFU, duty%) render exactly from the
+    /healthz device block; replicas without one degrade to '-' cells."""
+    with_dev = _healthy()
+    with_dev["device"] = {
+        "hbm_drift": "ok", "hbm_live_bytes": 600, "hbm_compiled_bytes": 1000,
+        "duty_cycle": 0.875, "mfu": 0.4321, "membw_util": 0.9,
+        "hbm_drift_bytes": -400, "dma_wait_fraction": 0.1}
+    drifting = _healthy()
+    drifting["device"] = {
+        "hbm_drift": "warn", "hbm_live_bytes": 1200,
+        "hbm_compiled_bytes": 1000, "duty_cycle": 1.0, "mfu": 0.05,
+        "membw_util": 0.99, "hbm_drift_bytes": 200,
+        "dma_wait_fraction": 0.0}
+    fleet = {
+        "backends": ["a:1", "b:2", "c:3"], "cooling_down": [],
+        "draining": [],
+        "replicas": {
+            "a:1": {"cooling": False, "draining": False, "health": with_dev},
+            "b:2": {"cooling": False, "draining": False, "health": drifting},
+            "c:3": {"cooling": False, "draining": False,
+                    "health": _healthy()},   # no device block at all
+        },
+    }
+    lines = tputop.render(fleet).splitlines()
+    row_a = next(ln for ln in lines if ln.startswith("a:1"))
+    # 600/1000 -> 3 of 5 cells filled, 60%; mfu 2 decimals; duty as percent
+    assert "###-- 60%" in row_a
+    assert " 0.43 " in row_a and " 88 " in row_a
+    row_b = next(ln for ln in lines if ln.startswith("b:2"))
+    # live over the ledger: bar saturates at 100% and flags the drift
+    assert "##### 100%!" in row_b
+    assert " 0.05 " in row_b and " 100 " in row_b
+    row_c = next(ln for ln in lines if ln.startswith("c:3"))
+    # no device block: every panel cell degrades to '-'
+    cells = row_c.split()
+    hbm_i = tputop.COLUMNS.index("hbm")
+    assert cells[hbm_i] == "-"
+    assert cells[hbm_i + 1] == "-" and cells[hbm_i + 2] == "-"
+
+
 def test_fetch_replicas_tolerates_dead_addr():
     fleet = tputop.fetch_replicas(["127.0.0.1:9"])   # nothing listens
     assert fleet["replicas"]["127.0.0.1:9"] == {"cooling": False,

@@ -834,3 +834,89 @@ def r9_flight_coverage(project: Project) -> List[Finding]:
     return out
 
 
+
+
+# ---------------------------------------------------------------------------
+# R10: tpu_device_* telemetry — both-route rendering + single-writer gauges
+# ---------------------------------------------------------------------------
+
+
+@rule("R10", "tpu_device_* rendered on both /metrics routes, one writer site")
+def r10_device_metrics(project: Project) -> List[Finding]:
+    """The device-telemetry layer (serving/devmon.py) has a stricter
+    contract than generic serving metrics:
+
+    1. every metric set registering a ``tpu_device_*`` name must be
+       rendered by BOTH the engine server's and the router's ``/metrics``
+       routes — the fleet view (router scrape) and the per-replica view
+       must never disagree about which device gauges exist;
+    2. each ``tpu_device_*`` metric attribute may be WRITTEN
+       (``inc/set/add/observe`` through a ``*.metrics.<attr>`` chain) from
+       at most one function across serving/ — the gauges are point-in-time
+       snapshots derived in one export step (``DevMon.export()``); a second
+       writer site means two code paths disagree about the device state and
+       the scraped value depends on which ran last.
+
+    Same resolution approximations as R2 (``_resolve_owner``); writer
+    sites are keyed by (file, enclosing function) so a loop inside one
+    exporter is a single site."""
+    out: List[Finding] = []
+    classes = _collect_metric_classes(project)
+    device_classes = {
+        name: mc for name, mc in classes.items()
+        if any(n.startswith("tpu_device_") for n in mc.attrs.values())}
+    if not device_classes:
+        return out
+
+    # (1) both routes must render every device metric set
+    server = project.get("serving/server.py")
+    router = project.get("serving/router.py")
+    if server is not None and router is not None:
+        server_owned = {_resolve_owner(c, server, project, classes)
+                        for c in _render_owners(server)}
+        router_owned = {_resolve_owner(c, router, project, classes)
+                        for c in _render_owners(router)}
+        for mc in sorted(device_classes.values(), key=lambda m: m.name):
+            missing = [r for r, owned in (("server", server_owned),
+                                          ("router", router_owned))
+                       if mc.name not in owned]
+            if missing:
+                out.append(Finding(
+                    "R10", mc.file.rel, mc.lineno,
+                    f"device metric set {mc.name} (tpu_device_* names) is "
+                    f"not rendered by the {' and '.join(missing)} /metrics "
+                    "route(s) — fleet and replica scrapes must expose the "
+                    "same device gauges"))
+
+    # (2) at most one writer site per device metric attribute
+    device_attrs = {attr
+                    for mc in device_classes.values()
+                    for attr, n in mc.attrs.items()
+                    if n.startswith("tpu_device_")}
+    writers: Dict[str, List[Tuple[str, str, int]]] = {}
+    for f in project.serving_files():
+        for node, ancestors in _walk_with_stack(f.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_OPS):
+                continue
+            chain = attr_chain(node.func.value)
+            if (len(chain) < 2 or chain[-2] != "metrics"
+                    or chain[-1] not in device_attrs):
+                continue
+            encl = _enclosing_funcdef(ancestors)
+            writers.setdefault(chain[-1], []).append(
+                (f.rel, encl.name if encl else "<module>", node.lineno))
+    for attr in sorted(writers):
+        sites = sorted({(path, fn) for path, fn, _ in writers[attr]})
+        if len(sites) <= 1:
+            continue
+        path, fn, lineno = max(writers[attr], key=lambda s: (s[0], s[2]))
+        others = ", ".join(f"{p}:{f}" for p, f in sites)
+        out.append(Finding(
+            "R10", path, lineno,
+            f"device metric attribute '{attr}' is written from "
+            f"{len(sites)} sites ({others}) — tpu_device_* gauges must "
+            "have exactly one writer (the devmon export step) so the "
+            "scraped value cannot depend on code-path ordering"))
+    return out

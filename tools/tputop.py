@@ -2,7 +2,9 @@
 
 ``python -m tools.tputop --router host:8080`` renders one row per engine
 replica — throughput, queue pressure, KV-pool occupancy, host-bubble share,
-SLO burn rates, and the flight recorder's last anomaly — from the router's
+the device panel (HBM bar vs the AOT ledger, decode MFU, duty cycle —
+serving/devmon.py via /healthz), SLO burn rates, and the flight recorder's
+last anomaly — from the router's
 ``/debug/fleet`` aggregation (one round trip per refresh; the router's ~1 Hz
 poller already holds every replica's last /load + /healthz sample).
 
@@ -25,7 +27,11 @@ import urllib.error
 import urllib.request
 
 COLUMNS = ("replica", "st", "tok/s", "act", "que", "pages", "bub%",
-           "burn5m", "last anomaly")
+           "hbm", "mfu", "duty%", "burn5m", "last anomaly")
+
+# burn column position (header logic keys off it; keep derived so the
+# device-panel columns can move without silently breaking the BURNING scan)
+BURN_COL = COLUMNS.index("burn5m")
 
 # worst 5m burn >= this renders as BURNING in the header (the Google-SRE
 # "burning exactly the budget" line; the page-now threshold is 14.4)
@@ -73,6 +79,20 @@ def _worst_burn(slo: dict) -> tuple:
     return worst, name
 
 
+def _hbm_bar(dev: dict, width: int = 5) -> str:
+    """Mini occupancy bar: live HBM over the AOT compiled ledger, with a
+    trailing ``!`` when the drift verdict is warning. No ledger = no
+    denominator = no bar."""
+    live = dev.get("hbm_live_bytes") or 0
+    comp = dev.get("hbm_compiled_bytes") or 0
+    warn = "!" if dev.get("hbm_drift") == "warn" else ""
+    if not comp:
+        return "-" + warn
+    frac = min(1.0, live / comp)
+    filled = int(round(frac * width))
+    return "#" * filled + "-" * (width - filled) + f" {100 * frac:.0f}%{warn}"
+
+
 def _row(addr: str, ent: dict) -> list:
     h = ent.get("health") or {}
     status = h.get("status", "?")
@@ -87,6 +107,9 @@ def _row(addr: str, ent: dict) -> list:
     pages_u = h.get("kv_pages_in_use") or 0
     pages = f"{pages_u}/{pages_t}" if pages_t else "-"
     bub = h.get("decode_bubble_pct")
+    dev = h.get("device") or {}
+    mfu = dev.get("mfu")
+    duty = dev.get("duty_cycle")
     burn, obj = _worst_burn(h.get("slo"))
     anomaly = "-"
     last = (h.get("flight") or {}).get("last_anomaly")
@@ -99,6 +122,9 @@ def _row(addr: str, ent: dict) -> list:
             "-" if que is None else str(que),
             pages,
             "-" if bub is None else f"{bub:.1f}",
+            _hbm_bar(dev),
+            "-" if mfu is None else f"{mfu:.2f}",
+            "-" if duty is None else f"{100.0 * duty:.0f}",
             f"{burn:.2f}" + (f" {obj}" if obj and burn >= BURN_WARN else ""),
             anomaly]
 
@@ -114,7 +140,7 @@ def render(fleet: dict) -> str:
     lines = []
     n = len(rows)
     burning = [r[0] for r in rows
-               if r[7] and float(r[7].split()[0]) >= BURN_WARN]
+               if r[BURN_COL] and float(r[BURN_COL].split()[0]) >= BURN_WARN]
     head = f"tpu-top — {n} replica{'s' if n != 1 else ''}"
     if fleet.get("draining"):
         head += f", {len(fleet['draining'])} draining"
