@@ -6,7 +6,8 @@ PY ?= python
 
 .PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests \
 	overload-smoke resume-smoke reconcile-smoke trace-smoke lint \
-	locksan-smoke aot-smoke pipeline-smoke flight-smoke devmon-smoke
+	locksan-smoke aot-smoke pipeline-smoke flight-smoke devmon-smoke \
+	capacity-smoke bench-diff
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -150,6 +151,24 @@ flight-smoke:
 devmon-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m devmon_smoke \
 		-p no:cacheprovider
+
+# Capacity-observatory smoke (serving/capacity.py): golden headroom-forecast
+# arithmetic under a fake clock, the OVERLOAD_BENCH.json replay (the
+# forecast must cross saturation at or below the measured shed knee),
+# byte-identical seeded streams estimator on/off, drop-not-fail export
+# chaos, and the router's /debug/capacity fleet aggregation. Tier-1 runs
+# the same tests (marker capacity_smoke).
+capacity-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m capacity_smoke \
+		-p no:cacheprovider
+
+# Artifact regression differ (tools/benchdiff.py): compare a fresh bench
+# run against the committed baseline before replacing it. Usage:
+#   make bench-diff A=OVERLOAD_BENCH.json B=/tmp/OVERLOAD_BENCH.json
+# Non-zero exit when a known metric moved the bad way past --threshold
+# (tok/s and speedups down, TTFT/bubble/ready-time up, shed knee earlier).
+bench-diff:
+	$(PY) -m tools.benchdiff $(A) $(B)
 
 # Full bench field-plumbing proof on CPU (tiny model, ~15 s): one JSON line
 # with every real-run field (bblock, weights_dtype, dma_steps_per_substep,

@@ -767,6 +767,17 @@ class ServingConfig:
     # to "warn" (never kills) when live occupancy exceeds the AOT ledger by
     # more than this.
     devmon_hbm_tolerance_mb: float = 64.0
+    # ---- Capacity & saturation observatory (serving/capacity.py) ----
+    # Headroom the recommended_replicas forecast buys, in seconds. The
+    # shipped default is the AOT registry's measured ready-time
+    # (BENCH_coldstart_r01 aot_ready_s ~= 5.5 s): a replica started the
+    # moment the signal fires is serving before the projected demand lands.
+    capacity_enabled: bool = True
+    capacity_headroom_s: float = 5.5
+    # Rate window (offered load, utilization) and the longer trend window
+    # the EWMA + linear-trend saturation forecast fits over.
+    capacity_window_s: float = 60.0
+    capacity_trend_window_s: float = 300.0
     # Seed for the engine's DERIVED sampling seeds (requests without an
     # OpenAI ``seed``). None = entropy from os.urandom at engine start, so
     # restarts and replicas draw independently (the vLLM/OpenAI
@@ -922,6 +933,11 @@ def ansible_vars(cfg: FrameworkConfig | None = None,
     # tpu_device_* gauges divide by the right ceilings per TPU generation.
     d["serving_devmon_peak_tflops"] = cfg.serving.devmon_peak_tflops
     d["serving_devmon_peak_hbm_gbps"] = cfg.serving.devmon_peak_hbm_gbps
+    # Capacity observatory (serving/capacity.py): the manifest threads these
+    # to --capacity-headroom-s / --capacity-window-s so the scaling signal's
+    # forecast horizon matches the deployment's measured AOT ready-time.
+    d["serving_capacity_headroom_s"] = cfg.serving.capacity_headroom_s
+    d["serving_capacity_window_s"] = cfg.serving.capacity_window_s
     # --set overrides (rehearsals pin model/ports); unknown keys pass
     # through — the playbooks treat group_vars as an open namespace
     d.update(overrides or {})

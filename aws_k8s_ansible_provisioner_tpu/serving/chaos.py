@@ -55,6 +55,13 @@ fault                       defined degradation behavior
                             background writer thread sees it: requests
                             succeed unchanged and the dump is dropped and
                             counted (``tpu_serve_flight_drops_total``)
+``capacity_export_error``   the capacity estimator's gauge refresh raises
+                            inside a /metrics or /healthz render: the
+                            render proceeds with the previous gauge values,
+                            the drop is counted
+                            (``tpu_capacity_export_drops_total``) and
+                            requests succeed unchanged — the estimator can
+                            never block a request
 ``deadline``                (engine-native, no injection needed) request
                             past its deadline is cancelled, slot/pages
                             released, client gets 408 deadline_exceeded
@@ -89,7 +96,7 @@ from typing import Dict, Optional
 FAULTS = ("connect_refused", "stalled_decode", "page_exhaustion",
           "slow_client", "mid_stream_disconnect", "kill_stream",
           "stream_read_error", "span_export", "pipeline_fetch_error",
-          "flight_dump_error")
+          "flight_dump_error", "capacity_export_error")
 
 
 class InjectedFault(RuntimeError):
@@ -350,6 +357,19 @@ class ChaosController:
         if mode == "hang":
             time.sleep(float(p.get("hang_s", 2.0)))
         raise OSError("chaos: flight spool write failed (disk full)")
+
+    def on_capacity_export(self) -> None:
+        """capacity.CapacityEstimator.export entry (a /metrics or /healthz
+        handler thread — observability reads, never a request path): an
+        armed ``capacity_export_error`` raises in place of the gauge
+        refresh. export() must swallow it, count the drop
+        (``tpu_capacity_export_drops_total``) and let the render proceed
+        with the previous gauge values — tests/test_capacity.py asserts
+        that drop-not-fail contract."""
+        p = self.fire("capacity_export_error")
+        if p is None:
+            return
+        raise InjectedFault("chaos: injected capacity export failure")
 
 
 _controller: Optional[ChaosController] = None

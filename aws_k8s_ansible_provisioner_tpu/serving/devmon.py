@@ -258,6 +258,31 @@ class DevMon:
                        if e[0] >= now - self.window_s)
         return min(1.0, busy / elapsed)
 
+    def service_rates(self, now: Optional[float] = None) -> dict:
+        """Decode-side service capacity over the window, aggregated across
+        the decode-like programs — the measurement serving/capacity.py
+        blends into its ceiling. ``measured_tps`` divides real device
+        seconds (already degraded by DMA-wait); ``roofline_tps`` divides
+        the analytical floor (what the chip could do at the roofline; equal
+        to measured when no cost model is installed, i.e. floor unknown)."""
+        now = self.clock() if now is None else now
+        progs = self.program_stats(now)
+        toks = dev = floor = 0.0
+        for kind in ("decode", "spec_decode"):
+            p = progs.get(kind)
+            if not p:
+                continue
+            toks += p["tokens"]
+            dev += p["device_seconds"]
+            floor += p["device_seconds"] * (1.0 - p["dma_wait_fraction"])
+        measured = (toks / dev) if dev > 0.0 else 0.0
+        roofline = (toks / floor) if floor > 0.0 else measured
+        return {"tokens": toks, "device_seconds": dev,
+                "measured_tps": measured, "roofline_tps": roofline,
+                "dma_wait_fraction": ((dev - floor) / dev) if dev > 0.0
+                else 0.0,
+                "duty_cycle": self.duty_cycle(now)}
+
     def hbm_snapshot(self) -> dict:
         """Live component map + drift vs the AOT compiled ledger. Verdict
         warns (never kills) when live exceeds compiled + tolerance."""

@@ -42,6 +42,7 @@ import jax
 import numpy as np
 
 from aws_k8s_ansible_provisioner_tpu.config import ModelConfig, ServingConfig
+from aws_k8s_ansible_provisioner_tpu.serving import capacity as _capacity
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
 from aws_k8s_ansible_provisioner_tpu.serving import devmon as _devmon
 from aws_k8s_ansible_provisioner_tpu.serving import flightrec as _flight
@@ -423,6 +424,7 @@ class Engine(EnginePrograms):
         # wiring — recording happens at the programs.py busy sites, and the
         # samplers never touch the device (sizes/dtypes are host metadata).
         self._install_devmon()
+        self._install_capacity()
 
     def _install_devmon(self):
         mon = _devmon.get()
@@ -456,6 +458,15 @@ class Engine(EnginePrograms):
 
         mon.install_hbm(_live, _compiled)
 
+    def _install_capacity(self):
+        """Hand the capacity estimator (serving/capacity.py) its engine
+        closures: live queue depth for the Little's-law delay, and the
+        throughput gauge as the ceiling fallback while devmon's decode
+        window is still empty. Pure wiring — offered-load recording
+        happens at the submit()/shed edges."""
+        _capacity.get().install_engine(
+            lambda: self.sched.stats().queue_depth,
+            lambda: self.metrics.tokens_per_second.value())
 
     @staticmethod
     def _build_mesh(serving: ServingConfig):
@@ -783,6 +794,8 @@ class Engine(EnginePrograms):
         if self.draining:
             self.metrics.requests_shed.inc(reason="draining")
             _slo.get().observe_admission(shed=True)
+            _capacity.get().observe_submit(tokens=max(1, req.max_tokens),
+                                           shed=True)
             _flight.record("shed", req.id, reason="draining")
             _flight.finish(req.id, "shed", ok=False)
             raise EngineOverloaded(
@@ -888,6 +901,8 @@ class Engine(EnginePrograms):
             if est > mw:
                 self.metrics.requests_shed.inc(reason="est_wait")
                 _slo.get().observe_admission(shed=True)
+                _capacity.get().observe_submit(
+                    tokens=max(1, req.max_tokens), shed=True)
                 _flight.record("shed", req.id, reason="est_wait",
                                est_wait_s=round(est, 3))
                 _flight.finish(req.id, "shed", ok=False)
@@ -931,6 +946,8 @@ class Engine(EnginePrograms):
                 self._resume_ctx.pop(req.id, None)
             self.metrics.requests_shed.inc(reason="queue_full")
             _slo.get().observe_admission(shed=True)
+            _capacity.get().observe_submit(tokens=max(1, req.max_tokens),
+                                           shed=True)
             _flight.record("shed", req.id, reason="queue_full",
                            queue_depth=st.queue_depth)
             _flight.finish(req.id, "shed", ok=False)
@@ -940,6 +957,8 @@ class Engine(EnginePrograms):
                 f"limit {self.serving.max_queue_depth})",
                 retry_after_s=self._estimated_wait_s(st) or 1.0)
         _slo.get().observe_admission(shed=False)
+        _capacity.get().observe_submit(tokens=max(1, req.max_tokens),
+                                       shed=False)
         _flight.record("queue", req.id, n_prompt=len(req.prompt_ids),
                        max_tokens=req.max_tokens)
         if req.resume_ids:

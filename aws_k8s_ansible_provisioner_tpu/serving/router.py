@@ -41,14 +41,15 @@ import http.client
 import itertools
 import json
 import logging
+import math
 import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
-from aws_k8s_ansible_provisioner_tpu.serving import (devmon, flightrec, slo,
-                                                     tracing)
+from aws_k8s_ansible_provisioner_tpu.serving import (capacity, devmon,
+                                                     flightrec, slo, tracing)
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import (
     Counter, Gauge, Registry)
 
@@ -390,6 +391,70 @@ def _affinity_key(path: str, body: bytes | None) -> str | None:
         return None
 
 
+def _fleet_capacity(fleet: dict) -> dict:
+    """Aggregate the per-replica ``capacity`` blocks (poller-stashed
+    /healthz) into the ``GET /debug/capacity`` fleet view.
+
+    Fleet offered load and fleet ceiling are straight sums over replicas
+    that report one (additive by construction — each replica measures its
+    own arrivals and its own service rate). A replica whose /healthz
+    predates serving/capacity.py (mixed-version fleet mid-rollout) gets an
+    ``available: false`` row and is excluded from the sums, so a rollout
+    never turns the dashboard into a KeyError and the fleet numbers only
+    claim the replicas actually measured. The fleet replica recommendation
+    scales total projected demand by the MEAN per-replica ceiling (what one
+    more replica of the current mix would add)."""
+    replicas = {}
+    offered = ceiling = projected = 0.0
+    reporting = saturated = 0
+    for addr, ent in fleet.items():
+        cap = (ent.get("health") or {}).get("capacity")
+        if not isinstance(cap, dict):
+            replicas[addr] = {"available": False}
+            continue
+        reporting += 1
+        row = {
+            "available": True,
+            "offered_tps": cap.get("offered_tps", 0.0),
+            "ceiling_tps": cap.get("ceiling_tps", 0.0),
+            "ceiling_source": cap.get("ceiling_source", "none"),
+            "utilization": cap.get("utilization", 0.0),
+            "queue_delay_s": cap.get("queue_delay_s", 0.0),
+            "seconds_to_saturation": cap.get("seconds_to_saturation"),
+            "saturated": bool(cap.get("saturated", False)),
+            "recommended_replicas": cap.get("recommended_replicas", 1),
+        }
+        if "health_age_s" in ent:
+            row["age_s"] = ent["health_age_s"]
+        replicas[addr] = row
+        offered += float(cap.get("offered_tps") or 0.0)
+        ceiling += float(cap.get("ceiling_tps") or 0.0)
+        projected += float(cap.get("projected_offered_tps")
+                           or cap.get("offered_tps") or 0.0)
+        if cap.get("saturated"):
+            saturated += 1
+    mean_ceiling = (ceiling / reporting) if reporting else 0.0
+    if mean_ceiling > 0:
+        recommended = max(reporting,
+                          math.ceil(projected / mean_ceiling - 1e-9))
+    else:
+        recommended = max(1, reporting)
+    return {
+        "replicas": replicas,
+        "fleet": {
+            "reporting_replicas": reporting,
+            "missing_replicas": len(fleet) - reporting,
+            "saturated_replicas": saturated,
+            "offered_tps": round(offered, 6),
+            "ceiling_tps": round(ceiling, 6),
+            "utilization": round(offered / ceiling, 6) if ceiling > 0
+            else 0.0,
+            "projected_offered_tps": round(projected, 6),
+            "recommended_replicas": recommended,
+        },
+    }
+
+
 def start_load_poller(pool: BackendPool, interval_s: float = 1.0,
                       stop: threading.Event | None = None,
                       metrics: RouterMetrics | None = None
@@ -627,7 +692,7 @@ class RouterHandler(BaseHTTPRequestHandler):
         are finished however the loop exits."""
         tracer = self.tracer
         if tracer is None or self.path.split("?")[0] in (
-                "/health", "/metrics", "/debug/fleet"):
+                "/health", "/metrics", "/debug/fleet", "/debug/capacity"):
             return self._proxy_impl(method)
         parent = tracing.parse_traceparent(
             self.headers.get(tracing.TRACEPARENT_HEADER))
@@ -681,13 +746,15 @@ class RouterHandler(BaseHTTPRequestHandler):
             # engine, so burn gauges stay at their exported defaults).
             slo.get().export()
             devmon.get().export()
+            capacity.get().export()
             om = "application/openmetrics-text" in \
                 (self.headers.get("Accept") or "")
             text = (self.metrics.registry.render(om)
                     + tracing.metrics.registry.render(om)
                     + flightrec.metrics.registry.render(om)
                     + slo.metrics.registry.render(om)
-                    + devmon.metrics.registry.render(om))
+                    + devmon.metrics.registry.render(om)
+                    + capacity.metrics.registry.render(om))
             if om:
                 text += "# EOF\n"
                 ctype = ("application/openmetrics-text; version=1.0.0; "
@@ -713,6 +780,15 @@ class RouterHandler(BaseHTTPRequestHandler):
                 "draining": self.pool.draining(),
                 "replicas": self.pool.fleet(),
             })
+            return
+        if self.path.split("?")[0] == "/debug/capacity":
+            # Fleet capacity aggregation: per-replica offered load vs
+            # service ceiling from the poller's last /healthz ``capacity``
+            # block, summed into fleet-level saturation + a fleet replica
+            # recommendation. Replicas running a pre-capacity build (mixed
+            # version fleet during a rollout) get an explicit
+            # ``available: false`` row rather than poisoning the sums.
+            self._respond_json(200, _fleet_capacity(self.pool.fleet()))
             return
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else None

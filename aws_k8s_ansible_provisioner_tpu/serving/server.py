@@ -28,8 +28,8 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
-from aws_k8s_ansible_provisioner_tpu.serving import (devmon, flightrec, slo,
-                                                     tracing)
+from aws_k8s_ansible_provisioner_tpu.serving import (capacity, devmon,
+                                                     flightrec, slo, tracing)
 from aws_k8s_ansible_provisioner_tpu.serving.engine import (
     ContextLengthExceeded, EngineOverloaded)
 
@@ -331,8 +331,9 @@ class Handler(BaseHTTPRequestHandler):
             from aws_k8s_ansible_provisioner_tpu.k8s.metrics_exporter import (
                 render_engine_chips)
 
-            slo.get().export()      # refresh the burn-rate gauges
-            devmon.get().export()   # refresh the tpu_device_* family
+            slo.get().export()       # refresh the burn-rate gauges
+            devmon.get().export()    # refresh the tpu_device_* family
+            capacity.get().export()  # refresh tpu_capacity_* (drop-not-fail)
             # Content negotiation: OpenMetrics (exemplars + # EOF) when the
             # scraper asks for it, classic Prometheus text otherwise.
             om = "application/openmetrics-text" in \
@@ -342,6 +343,7 @@ class Handler(BaseHTTPRequestHandler):
                     + flightrec.metrics.registry.render(om)
                     + slo.metrics.registry.render(om)
                     + devmon.metrics.registry.render(om)
+                    + capacity.metrics.registry.render(om)
                     + render_engine_chips())
             if om:
                 text += "# EOF\n"
@@ -434,6 +436,12 @@ class Handler(BaseHTTPRequestHandler):
                 # not a liveness failure.
                 "device": dev,
                 "hbm_drift": dev["hbm_drift"],
+                # Capacity block (serving/capacity.py): offered load vs the
+                # ceiling, saturation, and the seconds-to-saturation
+                # forecast — relayed by the router's poller into its
+                # /debug/capacity fleet aggregation. Recommendation-only:
+                # nothing in-process actuates on it.
+                "capacity": capacity.get().snapshot(),
             })
         elif path == "/readyz":
             # Readiness, distinct from liveness (r8): a DRAINING replica is
@@ -472,6 +480,11 @@ class Handler(BaseHTTPRequestHandler):
             # utilization, dma-wait share, plus the live HBM ledger — the
             # PERF.md model rendered against production traffic.
             self._json(200, devmon.get().snapshot())
+        elif path == "/debug/capacity":
+            # This replica's capacity/saturation/forecast view
+            # (serving/capacity.py) — the per-replica drill-down under the
+            # router's fleet-level /debug/capacity aggregation.
+            self._json(200, capacity.get().snapshot())
         elif path == "/debug/events":
             # the flight recorder's live ring, oldest first (?last=N caps it)
             import urllib.parse
@@ -1634,6 +1647,13 @@ def build_state(serving_cfg=None, model_cfg=None, params=None,
         peak_tflops=getattr(serving, "devmon_peak_tflops", 197.0),
         hbm_gbps=getattr(serving, "devmon_peak_hbm_gbps", 819.0),
         hbm_tolerance_mb=getattr(serving, "devmon_hbm_tolerance_mb", 64.0))
+    # Capacity estimator: configure() carries over the engine closures
+    # (queue depth, throughput fallback) installed during construction.
+    capacity.configure(
+        enabled=getattr(serving, "capacity_enabled", True),
+        headroom_s=getattr(serving, "capacity_headroom_s", 5.5),
+        window_s=getattr(serving, "capacity_window_s", 60.0),
+        trend_window_s=getattr(serving, "capacity_trend_window_s", 300.0))
     return state
 
 
@@ -1800,6 +1820,22 @@ def main(argv=None):
     p.add_argument("--no-devmon", action="store_true",
                    help="disable device telemetry recording (the "
                         "tpu_device_* gauges freeze at their defaults)")
+    p.add_argument("--capacity-headroom-s", type=float, default=5.5,
+                   help="forecast headroom the recommended_replicas figure "
+                        "buys, in seconds — set to the AOT registry's "
+                        "measured ready-time (BENCH_coldstart_r01: 5.5 s) "
+                        "so a replica started on the signal is serving "
+                        "before the projected demand lands")
+    p.add_argument("--capacity-window-s", type=float, default=60.0,
+                   help="sliding window for the offered-load and "
+                        "utilization rates (tpu_capacity_offered_tps)")
+    p.add_argument("--capacity-trend-window-s", type=float, default=300.0,
+                   help="longer window the saturation forecast fits its "
+                        "EWMA + linear trend over")
+    p.add_argument("--no-capacity", action="store_true",
+                   help="disable the capacity estimator (the "
+                        "tpu_capacity_* gauges freeze at their defaults; "
+                        "/healthz keeps an empty-ish capacity block)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--aot-manifest", default="",
                    help="AOT compile manifest (serving/aot.py) to adopt: "
@@ -1866,6 +1902,10 @@ def main(argv=None):
         devmon_peak_tflops=args.devmon_peak_tflops,
         devmon_peak_hbm_gbps=args.devmon_peak_hbm_gbps,
         devmon_hbm_tolerance_mb=args.devmon_hbm_tolerance_mb,
+        capacity_enabled=not args.no_capacity,
+        capacity_headroom_s=args.capacity_headroom_s,
+        capacity_window_s=args.capacity_window_s,
+        capacity_trend_window_s=args.capacity_trend_window_s,
         mesh=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep))
     state = build_state(serving)
     if args.aot_manifest:

@@ -157,12 +157,14 @@ class SLOEngine:
 
     # -- query side (deterministic at a fixed clock reading) -----------------
 
-    def burn_rate(self, objective: str, window_s: float,
-                  now: Optional[float] = None) -> float:
-        """(bad fraction in the window) / budget; 0.0 with no samples."""
-        obj = self.objectives.get(objective)
+    def bad_fraction(self, objective: str, window_s: float,
+                     now: Optional[float] = None) -> float:
+        """Raw bad fraction in the window (burn rate BEFORE the budget
+        division) — the shed/error pressure figure consumers that are not
+        budget-relative (serving/capacity.py's saturation view) read
+        directly. 0.0 with no samples or an unknown objective."""
         dq = self._samples.get(objective)
-        if obj is None or dq is None:
+        if dq is None:
             return 0.0
         t0 = (self.clock() if now is None else now) - window_s
         with self._lock:
@@ -172,9 +174,15 @@ class SLOEngine:
                     break
                 n += 1
                 bad += b
-        if n == 0:
+        return (bad / n) if n else 0.0
+
+    def burn_rate(self, objective: str, window_s: float,
+                  now: Optional[float] = None) -> float:
+        """(bad fraction in the window) / budget; 0.0 with no samples."""
+        obj = self.objectives.get(objective)
+        if obj is None:
             return 0.0
-        return (bad / n) / obj.budget
+        return self.bad_fraction(objective, window_s, now=now) / obj.budget
 
     def snapshot(self, now: Optional[float] = None) -> dict:
         """Per-objective burn rates for /healthz and the fleet view."""

@@ -449,6 +449,23 @@ def overload_bench(levels, n_replicas: int, n_requests: int,
     for s in stops:
         s.set()
     m = RouterHandler.metrics
+    # Shed knee: the first offered-load level that actually shed. The knee's
+    # offered_rps is the measured saturation point tools/benchdiff.py diffs
+    # across runs, and the max completed_rps across SATURATED levels is the
+    # fleet's measured service capacity (pre-knee completed == offered is
+    # only a lower bound) — tests/test_capacity.py replays the curve against
+    # exactly this figure.
+    knee = next((p for p in curve if p["shed"] > 0), None)
+    shed_knee = None
+    if knee is not None:
+        shed_knee = {
+            "concurrency": knee["concurrency"],
+            "offered_rps": knee["offered_rps"],
+            "shed_rate": knee["shed_rate"],
+            "completed_rps": knee["completed_rps"],
+            "service_capacity_rps": max(
+                p["completed_rps"] for p in curve if p["shed"] > 0),
+        }
     result = {
         "mode": "overload_bench",
         "platform": "cpu",
@@ -457,6 +474,7 @@ def overload_bench(levels, n_replicas: int, n_requests: int,
         "max_queue_depth": 2,
         "requests_per_level": n_requests,
         "router_429_retries": int(m.retries_429.total()),
+        "shed_knee": shed_knee,
         "curve": curve,
     }
     if dev_snap is not None:

@@ -224,6 +224,7 @@ def probe_l3(gv: Dict, inventory: Optional[str]) -> ProbeResult:
     bad = []
     burning = []
     drifting = []
+    saturating = []
     threshold = _slo_burn_threshold()
     for addr in addrs:
         status, body = _http_get(f"http://{addr}/readyz")
@@ -245,6 +246,20 @@ def probe_l3(gv: Dict, inventory: Optional[str]) -> ProbeResult:
         if h.get("hbm_drift") == "warn":
             drift = (h.get("device") or {}).get("hbm_drift_bytes", 0)
             drifting.append(f"{addr}:+{drift}B")
+        # Capacity saturation (serving/capacity.py via /healthz): same
+        # non-repairing contract as slo/hbm_drift — a saturated replica is
+        # serving at its ceiling and shedding by policy; restarting it
+        # would DESTROY capacity. The detail points the operator at the
+        # router's /debug/capacity fleet view (and the replica count
+        # recommendation) instead. Absent block = pre-capacity build
+        # (mixed-version fleet): silently skipped, never flagged.
+        cap = h.get("capacity")
+        if isinstance(cap, dict) and cap.get("saturated"):
+            util = cap.get("utilization", 0.0)
+            try:
+                saturating.append(f"{addr}:util={float(util):g}")
+            except (TypeError, ValueError):
+                saturating.append(f"{addr}:util=?")
         if threshold is None:
             continue
         for obj, d in sorted((h.get("slo") or {}).items()):
@@ -261,10 +276,12 @@ def probe_l3(gv: Dict, inventory: Optional[str]) -> ProbeResult:
                                   if burning else "ok")
     drift_detail = ", hbm_drift: " + (f"warn({', '.join(drifting)})"
                                       if drifting else "ok")
+    cap_detail = ", capacity: " + (f"saturating({', '.join(saturating)})"
+                                   if saturating else "ok")
     return ProbeResult("L3", not bad,
                        f"{len(addrs)} replica(s) "
                        + ("all ready" if not bad else "; ".join(bad))
-                       + slo_detail + drift_detail)
+                       + slo_detail + drift_detail + cap_detail)
 
 
 def gateway_addr(gv: Dict, inventory: Optional[str]) -> str:

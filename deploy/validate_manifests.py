@@ -170,6 +170,22 @@ def _validate_workload(d: dict, name: str):
                 _fail(name, f"{kind} {mname} container {c.get('name')} "
                             "passes --devmon-* flags but the pod template "
                             "has no prometheus.io/port annotation")
+        # Capacity-signal pairing (serving/capacity.py): the service-ceiling
+        # blend reads devmon's roofline/duty figures — a container tuning
+        # --capacity-* without --devmon-* silently degrades the ceiling to
+        # the engine's instantaneous tok/s gauge (ceiling_source="engine"),
+        # making the headroom forecast jitter with load. Tuned capacity
+        # flags therefore require the devmon flags in the same command.
+        # (CLI acceptance of the flags themselves is the R7 cross-check.)
+        if any(isinstance(a, str) and a.startswith("--capacity-")
+               for a in argv):
+            if not any(isinstance(a, str) and a.startswith("--devmon-")
+                       for a in argv):
+                _fail(name, f"{kind} {mname} container {c.get('name')} "
+                            "passes --capacity-* flags without --devmon-* "
+                            "flags — the capacity ceiling would fall back "
+                            "to the instantaneous engine gauge instead of "
+                            "the roofline-blended service rate")
         # Compile-cache pairing (AOT cold-start work, serving/aot.py): a
         # JAX_COMPILATION_CACHE_DIR env must point INSIDE a declared
         # volumeMount of the same container — a cache on the container's

@@ -1,0 +1,108 @@
+"""benchdiff (tools/benchdiff.py): the BENCH artifact regression differ.
+
+Pure-function tests over hand-built artifacts (diff/flatten/derive) plus a
+CLI pass over files on disk, including the committed OVERLOAD_BENCH.json
+diffed against itself (self-diff must always be clean — the invariant that
+makes ``make bench-diff`` safe to wire into a release checklist).
+"""
+
+import json
+
+import pytest
+
+from tools import benchdiff
+
+pytestmark = pytest.mark.benchdiff_smoke
+
+
+def test_flatten_picks_only_known_direction_keys():
+    m = benchdiff.flatten_metrics({
+        "toks_per_s": 100.0, "ttft_p95_ms": 80, "mode": "bench",
+        "n_replicas": 2, "nested": {"aot_ready_s": 5.53},
+        "curve": [{"completed_rps": 10.0}],     # lists never descended
+        "enabled": True,                        # bools are not metrics
+    })
+    assert m == {"toks_per_s": 100.0, "ttft_p95_ms": 80.0,
+                 "nested.aot_ready_s": 5.53}
+
+
+def test_diff_flags_regressions_by_direction():
+    base = {"toks_per_s": 100.0, "ttft_p95_ms": 100.0}
+    # throughput DOWN 10% and latency UP 10%: both are regressions
+    worse = {"toks_per_s": 90.0, "ttft_p95_ms": 110.0}
+    r = benchdiff.diff(base, worse, threshold_pct=5.0)
+    assert sorted(r["regressions"]) == ["toks_per_s", "ttft_p95_ms"]
+    # the same movements in the GOOD directions are improvements
+    better = {"toks_per_s": 110.0, "ttft_p95_ms": 90.0}
+    r = benchdiff.diff(base, better, threshold_pct=5.0)
+    assert r["regressions"] == []
+    assert all(v == "improved" for _, _, _, _, v in r["rows"])
+    # within the threshold: ok either way
+    r = benchdiff.diff(base, {"toks_per_s": 97.0, "ttft_p95_ms": 103.0},
+                       threshold_pct=5.0)
+    assert r["regressions"] == []
+    assert all(v == "ok" for _, _, _, _, v in r["rows"])
+
+
+def test_derive_shed_knee_from_raw_curve():
+    art = {"mode": "overload_bench", "curve": [
+        {"concurrency": 1, "offered_rps": 10.0, "shed": 0, "shed_rate": 0.0,
+         "completed_rps": 10.0},
+        {"concurrency": 8, "offered_rps": 126.0, "shed": 3,
+         "shed_rate": 0.075, "completed_rps": 117.0},
+        {"concurrency": 16, "offered_rps": 123.0, "shed": 9,
+         "shed_rate": 0.2, "completed_rps": 99.0},
+    ]}
+    benchdiff.derive_shed_knee(art)
+    assert art["shed_knee"]["concurrency"] == 8
+    assert art["shed_knee"]["offered_rps"] == 126.0
+    # service capacity = max completed over SATURATED levels, not the knee's
+    assert art["shed_knee"]["service_capacity_rps"] == 117.0
+    # non-overload artifacts and already-summarized ones are left alone
+    other = {"mode": "router_bench"}
+    benchdiff.derive_shed_knee(other)
+    assert "shed_knee" not in other
+
+
+def test_shed_knee_regression_is_caught():
+    """An earlier knee (sheds at lower offered load) must fail the diff —
+    the exact capacity regression this tool exists to catch."""
+    def art(offered):
+        return {"mode": "overload_bench", "curve": [
+            {"concurrency": 8, "offered_rps": offered, "shed": 3,
+             "shed_rate": 0.075, "completed_rps": offered * 0.9},
+        ]}
+    r = benchdiff.diff(art(126.0), art(100.0), threshold_pct=5.0)
+    assert "shed_knee.offered_rps" in r["regressions"]
+
+
+def test_cli_self_diff_of_committed_artifact_is_clean(capsys):
+    rc = benchdiff.main(["OVERLOAD_BENCH.json", "OVERLOAD_BENCH.json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 regressions" in out
+    assert "shed_knee.offered_rps" in out, \
+        "the knee must be derived from the committed curve and compared"
+
+
+def test_cli_regression_exit_codes(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({"toks_per_s": 100.0}))
+    b.write_text(json.dumps({"toks_per_s": 50.0}))
+    assert benchdiff.main([str(a), str(b)]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    # disjoint artifacts: honest "nothing compared" exit
+    c = tmp_path / "c.json"
+    c.write_text(json.dumps({"unrelated": 1.0}))
+    assert benchdiff.main([str(a), str(c)]) == 2
+    # unreadable file: same honest exit, on stderr
+    assert benchdiff.main([str(a), str(tmp_path / "missing.json")]) == 2
+
+
+def test_cli_reads_json_lines_artifacts(tmp_path):
+    """bench.py artifacts are JSON-lines; the first line is the run."""
+    p = tmp_path / "lines.json"
+    p.write_text(json.dumps({"toks_per_s": 100.0}) + "\n"
+                 + json.dumps({"toks_per_s": 90.0}) + "\n")
+    assert benchdiff.main([str(p), str(p)]) == 0

@@ -1,0 +1,590 @@
+"""Capacity & saturation observatory (serving/capacity.py).
+
+The forecasts under test are EXACT, not approximate: CapacityEstimator
+takes an injectable monotonic clock and injectable devmon/engine sources,
+so every offered-load rate, ceiling blend, EWMA level, trend slope and
+seconds-to-saturation figure is hand-computed arithmetic in literals.
+
+Contracts pinned here:
+
+- golden headroom-forecast arithmetic under a fake clock (bucketed trend,
+  EWMA 0.5, least-squares slope, Little's-law queue delay);
+- the OVERLOAD_BENCH.json replay: feeding the committed shed curve's
+  offered-load levels through the estimator, the forecast crosses
+  saturation AT OR BELOW the measured shed-rate knee — the signal fires
+  before the admission controller starts turning demand away;
+- seeded streams are BYTE-IDENTICAL estimator on vs off (observe_submit
+  is observability, never control flow);
+- the injected ``capacity_export_error`` chaos fault is counted
+  (``tpu_capacity_export_drops_total``) and costs one gauge refresh,
+  never a request or a /metrics render (drop-not-fail);
+- /healthz carries the capacity block, both /metrics routes render the
+  tpu_capacity_* family OpenMetrics-clean, and the router's
+  ``GET /debug/capacity`` aggregates >= 2 replicas — with an explicit
+  ``available: false`` row (not a KeyError) for a replica whose /healthz
+  predates this module (mixed-version fleet mid-rollout).
+
+``make capacity-smoke`` runs this file alone; tier-1 runs the same tests
+via the ``capacity_smoke`` marker.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+from aws_k8s_ansible_provisioner_tpu.serving import capacity
+from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
+from aws_k8s_ansible_provisioner_tpu.serving import devmon, flightrec, slo
+from aws_k8s_ansible_provisioner_tpu.serving.capacity import (
+    FORECAST_CAP_S, CapacityEstimator)
+from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
+from aws_k8s_ansible_provisioner_tpu.serving.router import (
+    BackendPool, _fleet_capacity, start_load_poller)
+from aws_k8s_ansible_provisioner_tpu.serving.server import build_state, serve
+from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+pytestmark = pytest.mark.capacity_smoke
+
+MODEL = "tiny-qwen3"
+_PORTS = iter(range(18900, 18960))
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    capacity.reset()
+    devmon.reset()
+    flightrec.reset()
+    slo.reset()
+    _chaos.reset()
+    yield
+    capacity.reset()
+    devmon.reset()
+    flightrec.reset()
+    slo.reset()
+    _chaos.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size, eos_token_id=tok.eos_token_id)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    return tok, cfg, params
+
+
+def _engine(model, **over):
+    tok, cfg, params = model
+    base = dict(weights_dtype="bf16", model=MODEL, max_decode_slots=2,
+                max_cache_len=128, page_size=32,
+                prefill_buckets=(16, 32, 64, 128), dtype="float32",
+                derived_seed=0)
+    base.update(over)
+    return Engine(cfg, params, ServingConfig(**base))
+
+
+def _drain(eng, limit=20000):
+    for _ in range(limit):
+        if not eng.step():
+            return
+    raise AssertionError("engine failed to quiesce")
+
+
+# ---------------------------------------------------------------------------
+# Golden forecast arithmetic on a scripted clock
+# ---------------------------------------------------------------------------
+
+
+def test_golden_forecast_arithmetic_hand_computed():
+    """Every figure below is closed-form from the scripted submits.
+
+    Ceiling: measured 100, roofline 140, blend 0.25 -> 100 + 0.25*40 = 110;
+    duty 1.0 >= floor 0.9 -> factor 1.0 -> ceiling 110.0 exactly.
+
+    Submits (t, tokens): (5,500) (15,600) (25,700) (35,800); queried at
+    t=40 the trend buckets (width 10, aligned to the window start, the
+    in-progress bucket excluded) are mids/rates (5,50) (15,60) (25,70)
+    (35,80).  EWMA(0.5) oldest->newest: 50 -> 55 -> 62.5 -> 71.25.
+    Least squares: slope exactly 1.0 tok/s per s.
+    seconds_to_saturation = (110 - 71.25) / 1.0 = 38.75.
+
+    Offered (60 s window, live part = 40 s): 2600/40 = 65.0 tok/s,
+    4/40 = 0.1 req/s; avg 650 tok/request.  Utilization 65/110.
+    Queue delay (Little): depth 3 * 650 / 110 = 19.5/1.1 s.
+    Projected = 71.25 + 1.0*5.5 = 76.75 -> 1 replica recommended."""
+    clk = FakeClock(0.0)
+    est = CapacityEstimator(headroom_s=5.5, window_s=60.0,
+                            trend_window_s=300.0, clock=clk)
+    est.install_devmon(lambda: {"measured_tps": 100.0,
+                                "roofline_tps": 140.0,
+                                "duty_cycle": 1.0})
+    est.install_engine(lambda: 3, lambda: 0.0)
+    for t, tokens in ((5.0, 500), (15.0, 600), (25.0, 700), (35.0, 800)):
+        clk.t = t
+        est.observe_submit(tokens=tokens)
+    clk.t = 40.0
+    snap = est.snapshot()
+    assert snap["ceiling_tps"] == pytest.approx(110.0)
+    assert snap["ceiling_source"] == "devmon"
+    assert snap["duty_factor"] == pytest.approx(1.0)
+    assert snap["offered_tps"] == pytest.approx(65.0)
+    assert snap["offered"]["requests_per_s"] == pytest.approx(0.1)
+    assert snap["offered"]["avg_tokens_per_request"] == pytest.approx(650.0)
+    assert snap["offered"]["shed_fraction"] == 0.0
+    assert snap["utilization"] == pytest.approx(65.0 / 110.0)
+    assert snap["ewma_offered_tps"] == pytest.approx(71.25)
+    assert snap["trend_tps_per_s"] == pytest.approx(1.0)
+    assert snap["seconds_to_saturation"] == pytest.approx(38.75)
+    assert snap["queue_depth"] == 3
+    assert snap["queue_delay_s"] == pytest.approx(3 * 650.0 / 110.0)
+    assert snap["projected_offered_tps"] == pytest.approx(76.75)
+    assert snap["recommended_replicas"] == 1
+    assert snap["saturated"] is False
+    # determinism: the same clock reading yields the same snapshot
+    assert est.snapshot() == snap
+
+
+def test_offered_counts_sheds_and_divides_live_window():
+    """Offered load is demand: shed submits count. Rates divide by the
+    LIVE part of the window — a 10 s old estimator must not dilute its
+    rate over the full 60 s."""
+    clk = FakeClock(0.0)
+    est = CapacityEstimator(window_s=60.0, clock=clk)
+    for i in range(10):
+        clk.t = float(i)
+        est.observe_submit(tokens=20, shed=(i % 2 == 0))
+    clk.t = 10.0
+    off = est.offered()
+    assert off["tokens_per_s"] == pytest.approx(200.0 / 10.0)
+    assert off["requests_per_s"] == pytest.approx(1.0)
+    assert off["admitted_per_s"] == pytest.approx(0.5)
+    assert off["shed_per_s"] == pytest.approx(0.5)
+    assert off["shed_fraction"] == pytest.approx(0.5)
+
+
+def test_ceiling_sources_devmon_engine_none():
+    """Source ladder: devmon service rates when a decode window exists,
+    the engine's own tok/s gauge when not (no roofline to blend), and an
+    honest zero ("none") when neither has measured anything — a zero
+    ceiling must read "unknown", never "infinite headroom"."""
+    clk = FakeClock(0.0)
+    est = CapacityEstimator(clock=clk)
+    # duty below the floor clamps UP to the floor assumption
+    est.install_devmon(lambda: {"measured_tps": 200.0,
+                                "roofline_tps": 300.0,
+                                "duty_cycle": 0.5})
+    c = est.ceiling()
+    assert c["source"] == "devmon"
+    assert c["duty_factor"] == pytest.approx(0.9)
+    assert c["ceiling_tps"] == pytest.approx((200 + 0.25 * 100) * 0.9)
+    # devmon empty -> engine gauge fallback, roofline == measured
+    est2 = CapacityEstimator(clock=clk)
+    est2.install_devmon(lambda: {})
+    est2.install_engine(lambda: 0, lambda: 150.0)
+    c2 = est2.ceiling()
+    assert c2["source"] == "engine"
+    assert c2["ceiling_tps"] == pytest.approx(150.0 * 0.9)
+    # nothing measured anywhere -> ceiling 0, forecast capped, not saturated
+    est3 = CapacityEstimator(clock=clk)
+    est3.install_devmon(lambda: {})
+    assert est3.ceiling()["source"] == "none"
+    snap = est3.snapshot()
+    assert snap["ceiling_tps"] == 0.0
+    assert snap["seconds_to_saturation"] == FORECAST_CAP_S
+    assert snap["saturated"] is False
+
+
+def test_flat_load_below_ceiling_forecast_caps():
+    """No upward trend -> no saturation within the horizon: the gauge
+    reads the finite cap (OpenMetrics-clean sentinel), never +Inf."""
+    clk = FakeClock(0.0)
+    est = CapacityEstimator(clock=clk)
+    est.install_devmon(lambda: {"measured_tps": 1000.0,
+                                "roofline_tps": 1000.0,
+                                "duty_cycle": 1.0})
+    for i in range(60):
+        clk.t = float(i)
+        est.observe_submit(tokens=10)
+    clk.t = 60.0
+    snap = est.snapshot()
+    assert snap["utilization"] < 1.0
+    assert snap["trend_tps_per_s"] == pytest.approx(0.0, abs=1e-6)
+    assert snap["seconds_to_saturation"] == FORECAST_CAP_S
+    assert snap["saturated"] is False
+    assert snap["recommended_replicas"] == 1
+
+
+def test_disabled_estimator_observes_nothing():
+    clk = FakeClock(0.0)
+    est = CapacityEstimator(enabled=False, clock=clk)
+    est.observe_submit(tokens=100)
+    clk.t = 1.0
+    assert est.offered()["tokens_per_s"] == 0.0
+    assert est.snapshot()["enabled"] is False
+
+
+def test_configure_carries_engine_wiring():
+    """build_state configures AFTER Engine.__init__ installs the closures
+    — the swap must carry them (the devmon configure contract)."""
+    est = capacity.get()
+    est.install_engine(lambda: 7, lambda: 42.0)
+    est.install_devmon(lambda: {"measured_tps": 10.0})
+    new = capacity.configure(headroom_s=9.0)
+    assert new is capacity.get() and new is not est
+    assert new.headroom_s == 9.0
+    assert new._queue_depth_fn() == 7
+    assert new._measured_tps_fn() == 42.0
+    assert new._devmon_fn()["measured_tps"] == 10.0
+
+
+# ---------------------------------------------------------------------------
+# OVERLOAD_BENCH replay: the forecast crosses saturation at/below the knee
+# ---------------------------------------------------------------------------
+
+
+def _replay_level(offered_rps: float, tokens: float, devmon_fn,
+                  duration_s: float = 60.0):
+    """One estimator fed ``duration_s`` of uniform arrivals at the level's
+    measured offered rate, snapshotted at the end of the window."""
+    clk = FakeClock(0.0)
+    est = CapacityEstimator(clock=clk)
+    est.install_devmon(devmon_fn)
+    n = max(1, int(offered_rps * duration_s))
+    for i in range(n):
+        clk.t = duration_s * i / n
+        est.observe_submit(tokens=tokens)
+    clk.t = duration_s
+    return est.snapshot()
+
+
+def test_overload_replay_forecast_crosses_at_or_below_shed_knee():
+    """Replay the committed shed-rate curve (OVERLOAD_BENCH.json, real
+    requests through the real router) through the estimator, with the
+    service rate calibrated the way production would see it: from the
+    SATURATED levels' completed throughput (pre-knee completed == offered
+    is only a lower bound on capacity — the fleet was not full).
+
+    Acceptance: the predicted ceiling sits at or below the measured shed
+    knee's offered load, i.e. the forecast declares saturation no later
+    than the admission controller starts shedding; levels comfortably
+    below the ceiling must not read saturated."""
+    with open("OVERLOAD_BENCH.json") as f:
+        bench = json.load(f)
+    curve = bench["curve"]
+    shedding = [p for p in curve if p["shed"] > 0]
+    assert shedding, "committed artifact must exercise shedding"
+    knee = shedding[0]
+    service_rps = max(p["completed_rps"] for p in shedding)
+    tokens = 16.0   # the overload bench's per-request decode budget
+
+    # Measured-only source: roofline == measured (the CPU rehearsal has no
+    # cost model), duty at the floor -> ceiling = measured * 0.9.
+    def devmon_fn(measured=service_rps * tokens):
+        return {"measured_tps": measured, "roofline_tps": measured,
+                "duty_cycle": 0.0}
+
+    ceiling_tps = _replay_level(
+        curve[0]["offered_rps"], tokens, devmon_fn)["ceiling_tps"]
+    ceiling_rps = ceiling_tps / tokens
+    assert ceiling_rps <= knee["offered_rps"], \
+        (f"predicted ceiling {ceiling_rps:.2f} req/s must not exceed the "
+         f"measured shed knee {knee['offered_rps']:.2f} req/s — the "
+         "forecast would declare saturation only after shedding began")
+
+    for p in curve:
+        snap = _replay_level(p["offered_rps"], tokens, devmon_fn)
+        if p["shed"] == 0 and p["offered_rps"] * tokens < 0.8 * ceiling_tps:
+            assert snap["saturated"] is False, \
+                f"level conc={p['concurrency']} is well under the ceiling"
+            assert snap["seconds_to_saturation"] > 0.0
+        if p is knee:
+            assert snap["saturated"] is True, \
+                "the measured shed knee must read saturated"
+            assert snap["seconds_to_saturation"] == 0.0
+            assert snap["recommended_replicas"] > 1
+    # the artifact's own shed_knee summary (bench_sweep writes it; the
+    # differ derives it for older artifacts) agrees with the raw curve
+    sk = bench.get("shed_knee")
+    if sk:
+        assert sk["offered_rps"] == knee["offered_rps"]
+        assert sk["service_capacity_rps"] == service_rps
+
+
+# ---------------------------------------------------------------------------
+# Determinism: seeded streams byte-identical estimator on vs off
+# ---------------------------------------------------------------------------
+
+
+def _stream_bytes(req):
+    lp = None
+    if req.logprob_data is not None:
+        lp = tuple((own, tuple(alts)) for own, alts in req.logprob_data)
+    return (tuple(req.generated), req.finish_reason, lp)
+
+
+def test_seeded_streams_byte_identical_capacity_on_off(model):
+    """observe_submit is observability, never control flow: the token
+    stream is a pure function of the seed whether or not the estimator is
+    recording arrivals."""
+    specs = [
+        dict(prompt_ids=[5, 9, 2], max_tokens=10, temperature=0.9,
+             ignore_eos=True, seed=42),
+        dict(prompt_ids=[7, 7, 3], max_tokens=12, temperature=0.8, seed=11,
+             ignore_eos=True, logprobs=3),
+        dict(prompt_ids=[23, 42], max_tokens=8, temperature=0.0,
+             ignore_eos=True),
+    ]
+    capacity.configure(enabled=True)
+    eng_on = _engine(model)
+    on = [eng_on.submit(Request(**dict(s))) for s in specs]
+    _drain(eng_on)
+    assert capacity.get().offered()["requests_per_s"] > 0.0, \
+        "enabled estimator must have observed the submits"
+    capacity.configure(enabled=False)
+    eng_off = _engine(model)
+    off = [eng_off.submit(Request(**dict(s))) for s in specs]
+    _drain(eng_off)
+    assert capacity.get().offered()["requests_per_s"] == 0.0
+    for a, b in zip(on, off):
+        assert _stream_bytes(a) == _stream_bytes(b), \
+            "stream must be byte-identical capacity estimator on vs off"
+
+
+# ---------------------------------------------------------------------------
+# Chaos: injected export failure is counted, never felt
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_capacity_export_error_drop_not_fail():
+    """An injected ``capacity_export_error`` costs exactly one gauge
+    refresh: export() returns None, the drop is counted, and the NEXT
+    export succeeds with fresh values."""
+    est = capacity.get()
+    est.observe_submit(tokens=50)
+    d0 = capacity.metrics.export_drops.total()
+    _chaos.get().inject("capacity_export_error", times=1)
+    assert est.export() is None
+    assert capacity.metrics.export_drops.total() - d0 == 1
+    snap = est.export()
+    assert snap is not None, "one-shot fault: the next export recovers"
+    assert capacity.metrics.export_drops.total() - d0 == 1
+
+
+# ---------------------------------------------------------------------------
+# Pure fleet aggregation (router._fleet_capacity)
+# ---------------------------------------------------------------------------
+
+
+def _cap_block(offered, ceiling, projected=None, saturated=False):
+    return {"offered_tps": offered, "ceiling_tps": ceiling,
+            "ceiling_source": "devmon",
+            "utilization": offered / ceiling if ceiling else 0.0,
+            "queue_delay_s": 0.0, "seconds_to_saturation": 100.0,
+            "saturated": saturated,
+            "projected_offered_tps": projected
+            if projected is not None else offered,
+            "recommended_replicas": 1}
+
+
+def test_fleet_capacity_aggregation_sums_and_na_rows():
+    fleet = {
+        "10.0.0.1:8000": {"health": {"capacity": _cap_block(60.0, 100.0)},
+                          "health_age_s": 0.5},
+        "10.0.0.2:8000": {"health": {"capacity": _cap_block(
+            90.0, 100.0, projected=240.0, saturated=True)}},
+        # mixed-version replica: /healthz has no capacity block
+        "10.0.0.3:8000": {"health": {"status": "ok"}},
+    }
+    agg = _fleet_capacity(fleet)
+    assert agg["replicas"]["10.0.0.3:8000"] == {"available": False}
+    assert agg["replicas"]["10.0.0.1:8000"]["available"] is True
+    assert agg["replicas"]["10.0.0.1:8000"]["age_s"] == 0.5
+    f = agg["fleet"]
+    assert f["reporting_replicas"] == 2
+    assert f["missing_replicas"] == 1
+    assert f["saturated_replicas"] == 1
+    assert f["offered_tps"] == pytest.approx(150.0)
+    assert f["ceiling_tps"] == pytest.approx(200.0)
+    assert f["utilization"] == pytest.approx(0.75)
+    # projected 60 + 240 = 300 over a 100 tok/s mean per-replica ceiling
+    assert f["projected_offered_tps"] == pytest.approx(300.0)
+    assert f["recommended_replicas"] == 3
+
+
+def test_fleet_capacity_aggregation_empty_and_all_missing():
+    assert _fleet_capacity({})["fleet"]["recommended_replicas"] == 1
+    agg = _fleet_capacity({"a:1": {}, "b:2": {"health": {}}})
+    assert agg["fleet"]["reporting_replicas"] == 0
+    assert agg["fleet"]["missing_replicas"] == 2
+    assert all(r == {"available": False} for r in agg["replicas"].values())
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: server surfaces + router /debug/capacity with a mixed fleet
+# ---------------------------------------------------------------------------
+
+
+class _StrippedReplicaHandler(BaseHTTPRequestHandler):
+    """A pre-capacity build: answers /load and a /healthz WITHOUT the
+    device/slo/flight/capacity blocks (the mixed-version regression)."""
+
+    def do_GET(self):
+        if self.path == "/load":
+            body = json.dumps({"active": 0, "queued": 0}).encode()
+        elif self.path == "/healthz":
+            body = json.dumps({"status": "ok"}).encode()
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_server_and_router_capacity_end_to_end(model):
+    tok, cfg, params = model
+    serving = ServingConfig(
+        weights_dtype="bf16", model=MODEL, max_decode_slots=2,
+        max_cache_len=128, page_size=32,
+        prefill_buckets=(16, 32, 64, 128), dtype="float32", derived_seed=0,
+        capacity_headroom_s=7.5)
+    state = build_state(serving, model_cfg=cfg, params=params, tokenizer=tok)
+    assert capacity.get().headroom_s == 7.5, \
+        "build_state must configure the estimator from ServingConfig"
+    port = next(_PORTS)
+    ready, stop = threading.Event(), threading.Event()
+    threading.Thread(target=serve,
+                     args=(state, "127.0.0.1", port, ready, stop),
+                     daemon=True).start()
+    assert ready.wait(10)
+    stripped = ThreadingHTTPServer(("127.0.0.1", 0), _StrippedReplicaHandler)
+    threading.Thread(target=stripped.serve_forever, daemon=True).start()
+    addr = f"127.0.0.1:{port}"
+    stripped_addr = f"127.0.0.1:{stripped.server_port}"
+    poll_stop = threading.Event()
+    try:
+        def get(path, headers=None):
+            req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                         headers=headers or {})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, r.headers.get("Content-Type", ""), r.read()
+
+        body = json.dumps({"model": MODEL, "prompt": "hi", "max_tokens": 4,
+                           "ignore_eos": True}).encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=120) as r:
+            assert r.status == 200
+
+        # /healthz carries the capacity block the poller relays
+        st, _, raw = get("/healthz")
+        h = json.loads(raw)
+        assert st == 200
+        cap = h["capacity"]
+        assert cap["enabled"] is True and cap["headroom_s"] == 7.5
+        assert cap["offered"]["requests_per_s"] > 0.0
+        assert cap["seconds_to_saturation"] <= 3600.0
+
+        # /debug/capacity mirrors the snapshot
+        st, _, raw = get("/debug/capacity")
+        assert st == 200 and json.loads(raw)["enabled"] is True
+
+        # engine /metrics: classic + OpenMetrics-clean (one EOF, no +Inf
+        # on the capacity gauges — the forecast cap is a finite sentinel)
+        st, ctype, raw = get("/metrics")
+        text = raw.decode()
+        assert st == 200 and "tpu_capacity_offered_tps" in text
+        assert "tpu_capacity_seconds_to_saturation" in text
+        st, ctype, raw = get(
+            "/metrics", {"Accept": "application/openmetrics-text"})
+        om = raw.decode()
+        assert ctype.startswith("application/openmetrics-text")
+        assert om.endswith("# EOF\n") and om.count("# EOF") == 1
+        assert "tpu_capacity_ceiling_tps" in om
+        for line in om.splitlines():
+            if line.startswith("tpu_capacity_"):
+                assert "Inf" not in line and "NaN" not in line
+
+        # drop-not-fail at the route: an injected export fault leaves the
+        # scrape a 200 and lands in the drop counter (delta-based: the
+        # counter is process-wide across this file's tests)
+        d0 = capacity.metrics.export_drops.total()
+        _chaos.get().inject("capacity_export_error", times=1)
+        st, _, raw = get("/metrics")
+        assert st == 200
+        assert capacity.metrics.export_drops.total() - d0 == 1
+        assert f"tpu_capacity_export_drops_total {d0 + 1}" \
+            in raw.decode()
+
+        # router: poll BOTH replicas (real + stripped pre-capacity build),
+        # then /debug/capacity aggregates them with an n/a row
+        pool = BackendPool(f"{addr},{stripped_addr}")
+        start_load_poller(pool, interval_s=0.2, stop=poll_stop)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            fl = pool.fleet()
+            if all((fl.get(a, {}).get("health"))
+                   for a in (addr, stripped_addr)):
+                break
+            time.sleep(0.05)
+
+        from aws_k8s_ansible_provisioner_tpu.serving.router import (
+            RouterHandler, RouterMetrics)
+        old = RouterHandler.pool, RouterHandler.metrics
+        RouterHandler.pool = pool
+        RouterHandler.metrics = RouterMetrics()
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            rurl = f"http://127.0.0.1:{srv.server_port}"
+            with urllib.request.urlopen(rurl + "/debug/capacity",
+                                        timeout=10) as r:
+                agg = json.loads(r.read())
+            assert agg["replicas"][addr]["available"] is True
+            assert agg["replicas"][addr]["offered_tps"] >= 0.0
+            assert agg["replicas"][stripped_addr] == {"available": False}
+            assert agg["fleet"]["reporting_replicas"] == 1
+            assert agg["fleet"]["missing_replicas"] == 1
+            assert agg["fleet"]["recommended_replicas"] >= 1
+            # the router's own /metrics renders the capacity family too
+            # (tpulint R11 both-routes contract)
+            with urllib.request.urlopen(rurl + "/metrics",
+                                        timeout=10) as r:
+                rm = r.read().decode()
+            assert "tpu_capacity_offered_tps" in rm
+            req = urllib.request.Request(
+                rurl + "/metrics",
+                headers={"Accept": "application/openmetrics-text"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                rom = r.read().decode()
+            assert rom.endswith("# EOF\n") and rom.count("# EOF") == 1
+        finally:
+            srv.shutdown()
+            RouterHandler.pool, RouterHandler.metrics = old
+    finally:
+        poll_stop.set()
+        stripped.shutdown()
+        stop.set()
+        time.sleep(0.1)

@@ -695,6 +695,114 @@ def test_r10_silent_when_no_device_metrics_exist(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# R11: tpu_capacity_* both-route rendering + single writer in its module
+# ---------------------------------------------------------------------------
+
+
+_R11_BASE = {
+    "pkg/serving/capacity.py": """
+        class CapacityMetrics:
+            def __init__(self):
+                r = Registry()
+                self.registry = r
+                self.offered_tps = r.register(
+                    Gauge("tpu_capacity_offered_tps", "demand tok/s"))
+                self.ceiling_tps = r.register(
+                    Gauge("tpu_capacity_ceiling_tps", "service tok/s"))
+
+        metrics = CapacityMetrics()
+
+        class CapacityEstimator:
+            def export(self):
+                metrics.offered_tps.set(65.0)
+                metrics.ceiling_tps.set(110.0)
+    """,
+    "pkg/serving/server.py": """
+        class Handler:
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = capacity.metrics.registry.render()
+    """,
+    "pkg/serving/router.py": """
+        class RHandler:
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = capacity.metrics.registry.render()
+    """,
+}
+
+
+def test_r11_clean_when_both_routes_render_and_one_writer(tmp_path):
+    assert _lint(tmp_path, _R11_BASE, only=["R11"]) == []
+
+
+def test_r11_fires_when_server_route_misses_capacity_set(tmp_path):
+    files = dict(_R11_BASE)
+    files["pkg/serving/server.py"] = """
+        class Handler:
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = own.metrics.registry.render()
+    """
+    fs = _lint(tmp_path, files, only=["R11"])
+    assert _rules_of(fs) == ["R11"]
+    assert "server" in fs[0].message and "CapacityMetrics" in fs[0].message
+
+
+def test_r11_fires_on_second_writer_site(tmp_path):
+    files = dict(_R11_BASE)
+    files["pkg/serving/engine.py"] = """
+        class Engine:
+            def step(self):
+                capacity.metrics.offered_tps.set(0.1)
+    """
+    fs = _lint(tmp_path, files, only=["R11"])
+    assert _rules_of(fs) == ["R11"]
+    assert "'offered_tps'" in fs[0].message and "2 sites" in fs[0].message
+
+
+def test_r11_fires_when_single_writer_lives_outside_capacity_module(
+        tmp_path):
+    """One writer site is necessary but not sufficient: a route handler
+    setting the gauge inline (bypassing the export step's drop-not-fail
+    guard) is flagged even though it is the ONLY writer."""
+    files = dict(_R11_BASE)
+    files["pkg/serving/capacity.py"] = """
+        class CapacityMetrics:
+            def __init__(self):
+                r = Registry()
+                self.registry = r
+                self.offered_tps = r.register(
+                    Gauge("tpu_capacity_offered_tps", "demand tok/s"))
+
+        metrics = CapacityMetrics()
+    """
+    files["pkg/serving/server.py"] = """
+        class Handler:
+            def do_GET(self):
+                if self.path == "/metrics":
+                    capacity.metrics.offered_tps.set(1.0)
+                    body = capacity.metrics.registry.render()
+    """
+    fs = _lint(tmp_path, files, only=["R11"])
+    assert _rules_of(fs) == ["R11"]
+    assert "serving/server.py" in fs[0].message \
+        and "serving/capacity.py" in fs[0].message
+
+
+def test_r11_silent_when_no_capacity_metrics_exist(tmp_path):
+    fs = _lint(tmp_path, {"pkg/serving/metrics.py": """
+        class EngineMetrics:
+            def __init__(self):
+                r = Registry()
+                self.registry = r
+                self.requests = r.register(
+                    Counter("tpu_serve_requests_total", "n"))
+    """}, only=["R11"])
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
 # runner semantics
 # ---------------------------------------------------------------------------
 

@@ -143,6 +143,71 @@ def test_render_device_panel_golden_frame():
     assert cells[hbm_i + 1] == "-" and cells[hbm_i + 2] == "-"
 
 
+def test_render_capacity_panel_golden_frame():
+    """The capacity columns (headroom bar, saturation sparkline) render
+    exactly from the /healthz capacity block; the sparkline prefers the
+    watch loop's history and falls back to the current sample."""
+    calm = _healthy()
+    calm["capacity"] = {"utilization": 0.6, "saturated": False,
+                        "seconds_to_saturation": 3600.0}
+    hot = _healthy()
+    hot["capacity"] = {"utilization": 1.4, "saturated": True,
+                       "seconds_to_saturation": 0.0}
+    fleet = {
+        "backends": ["a:1", "b:2"], "cooling_down": [], "draining": [],
+        "replicas": {
+            "a:1": {"cooling": False, "draining": False, "health": calm},
+            "b:2": {"cooling": False, "draining": False, "health": hot},
+        },
+    }
+    # no history: one tick from the current utilization
+    lines = tputop.render(fleet).splitlines()
+    row_a = next(ln for ln in lines if ln.startswith("a:1"))
+    assert "###-- 60%" in row_a       # 0.6 -> 3 of 5 cells, no warn mark
+    row_b = next(ln for ln in lines if ln.startswith("b:2"))
+    assert "##### 100%!" in row_b     # clamped bar + saturation mark
+    cap_i = tputop.COLUMNS.index("cap")
+    assert row_b.split()[cap_i + 2] == "#"   # 1.4 clamps to the ramp top
+    # watch-loop history drives the sparkline, newest on the right:
+    # 0 -> ' ', 0.25 -> ':', 0.5 -> '=', 0.75 -> '+', 1.0 -> '#'
+    hist = {"a:1": [0.0, 0.25, 0.5, 0.75, 1.0]}
+    row_a = next(ln for ln in tputop.render(fleet, caphist=hist).splitlines()
+                 if ln.startswith("a:1"))
+    assert " :=+#" in row_a
+
+
+def test_render_mixed_version_fleet_na_capacity_cells():
+    """A replica whose /healthz predates serving/capacity.py (rollout in
+    progress) must render '-' capacity cells — not a KeyError — while a
+    sibling on the new build renders its panel."""
+    new_build = _healthy()
+    new_build["capacity"] = {"utilization": 0.2, "saturated": False}
+    old_build = _healthy()                    # no capacity block at all
+    stripped = {"status": "ok"}               # no device/slo/flight either
+    fleet = {
+        "backends": ["a:1", "b:2", "c:3"], "cooling_down": [],
+        "draining": [],
+        "replicas": {
+            "a:1": {"cooling": False, "draining": False,
+                    "health": new_build},
+            "b:2": {"cooling": False, "draining": False,
+                    "health": old_build},
+            "c:3": {"cooling": False, "draining": False,
+                    "health": stripped},
+        },
+    }
+    lines = tputop.render(fleet).splitlines()
+    row_a = next(ln for ln in lines if ln.startswith("a:1"))
+    assert "#---- 20%" in row_a
+    cap_i = tputop.COLUMNS.index("cap")
+    for addr in ("b:2", "c:3"):
+        row = next(ln for ln in lines if ln.startswith(addr))
+        cells = row.split()
+        assert cells[cap_i] == "-" and cells[cap_i + 1] == "-", \
+            f"{addr} must degrade to n/a capacity cells"
+    assert "SLO ok" in lines[0]
+
+
 def test_fetch_replicas_tolerates_dead_addr():
     fleet = tputop.fetch_replicas(["127.0.0.1:9"])   # nothing listens
     assert fleet["replicas"]["127.0.0.1:9"] == {"cooling": False,

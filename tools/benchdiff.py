@@ -1,0 +1,169 @@
+"""benchdiff: regression differ for the repo's BENCH_*.json artifacts.
+
+``python -m tools.benchdiff BASELINE.json CANDIDATE.json [--threshold 5]``
+compares two bench artifacts of the same mode (bench.py lines,
+bench_sweep.py sweeps, OVERLOAD_BENCH curves, ROUTER_BENCH aggregates,
+cold-start and pipeline A/Bs) and exits non-zero when the candidate moved a
+known metric in the BAD direction by more than the threshold percentage —
+the check a perf PR runs against the committed artifact before replacing
+it (``make bench-diff A=old.json B=new.json``).
+
+Metric direction is curated, not guessed: ``HIGHER_BETTER`` /
+``LOWER_BETTER`` name the scalar keys that are throughputs/speedups vs
+latencies/bubbles, matched by key basename anywhere in the artifact (nested
+dicts walk recursively with dotted paths; lists are skipped — per-level
+curve points are samples, not summary metrics). Overload artifacts predating
+the ``shed_knee`` summary block get it derived from their ``curve`` on the
+fly, so old committed baselines stay comparable.
+
+Exit codes: 0 = no regressions, 1 = at least one regression, 2 = the two
+artifacts share no comparable metrics (different modes or not bench JSON).
+stdlib-only, like every tool in tools/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# key basename -> desired direction (throughputs, ratios-of-goodness)
+HIGHER_BETTER = frozenset({
+    "toks_per_s", "agg_toks_per_s", "sync_toks_per_s", "pipe_toks_per_s",
+    "ceiling_toks_per_s", "pct_of_ceiling", "speedup", "warm_speedup",
+    "aot_speedup", "prefix_hit_rate", "bubble_reduction_pct",
+    "offered_rps", "completed_rps", "service_capacity_rps",
+})
+# latencies, bubbles, ready times
+LOWER_BETTER = frozenset({
+    "ttft_p50_ms", "ttft_p95_ms", "ttft_mean_ms", "ttft_ms",
+    "sync_bubble_ms_per_step", "pipe_bubble_ms_per_step",
+    "bubble_ms_per_step", "cold_ready_s", "warm_ready_s", "aot_ready_s",
+    "dispatch_rtt_ms", "failover_first_success_ms", "latency_p50_ms",
+    "latency_p95_ms", "shed_rate",
+})
+
+
+def derive_shed_knee(artifact: dict) -> None:
+    """Backfill the ``shed_knee`` summary bench_sweep.py now writes from an
+    older overload artifact's raw ``curve`` (first shedding level + max
+    completed_rps over saturated levels), in place. No curve or no shedding
+    level leaves the artifact untouched."""
+    if artifact.get("mode") != "overload_bench" or artifact.get("shed_knee"):
+        return
+    curve = artifact.get("curve") or []
+    knee = next((p for p in curve if isinstance(p, dict)
+                 and p.get("shed", 0) > 0), None)
+    if knee is None:
+        return
+    artifact["shed_knee"] = {
+        "concurrency": knee.get("concurrency"),
+        "offered_rps": knee.get("offered_rps"),
+        "shed_rate": knee.get("shed_rate"),
+        "completed_rps": knee.get("completed_rps"),
+        "service_capacity_rps": max(
+            p.get("completed_rps", 0.0) for p in curve
+            if isinstance(p, dict) and p.get("shed", 0) > 0),
+    }
+
+
+def flatten_metrics(obj, prefix: str = "") -> dict:
+    """Dotted-path -> value for every known-direction numeric leaf.
+    Lists are not descended (curve points are per-level samples; the
+    summary blocks carry the comparable figures)."""
+    out: dict = {}
+    if not isinstance(obj, dict):
+        return out
+    for key, val in obj.items():
+        path = f"{prefix}.{key}" if prefix else key
+        if isinstance(val, dict):
+            out.update(flatten_metrics(val, path))
+        elif isinstance(val, (int, float)) and not isinstance(val, bool) \
+                and key in (HIGHER_BETTER | LOWER_BETTER):
+            out[path] = float(val)
+    return out
+
+
+def diff(base: dict, cand: dict, threshold_pct: float = 5.0) -> dict:
+    """Compare two loaded artifacts. Returns ``{"rows": [...],
+    "regressions": [...], "comparable": int}`` where each row is
+    ``(path, base, cand, delta_pct, verdict)`` and verdict is one of
+    ``ok`` / ``improved`` / ``REGRESSION``."""
+    for art in (base, cand):
+        derive_shed_knee(art)
+    bm, cm = flatten_metrics(base), flatten_metrics(cand)
+    rows, regressions = [], []
+    for path in sorted(bm.keys() & cm.keys()):
+        b, c = bm[path], cm[path]
+        basename = path.rsplit(".", 1)[-1]
+        if b == 0:
+            delta_pct = 0.0 if c == 0 else float("inf") * (1 if c > 0 else -1)
+        else:
+            delta_pct = 100.0 * (c - b) / abs(b)
+        # regression = movement in the bad direction past the threshold
+        bad = -delta_pct if basename in HIGHER_BETTER else delta_pct
+        if bad > threshold_pct:
+            verdict = "REGRESSION"
+            regressions.append(path)
+        elif bad < -threshold_pct:
+            verdict = "improved"
+        else:
+            verdict = "ok"
+        rows.append((path, b, c, delta_pct, verdict))
+    return {"rows": rows, "regressions": regressions, "comparable": len(rows)}
+
+
+def render(result: dict, base_name: str, cand_name: str,
+           threshold_pct: float) -> str:
+    """Human-readable diff table (pure; tests assert cells)."""
+    lines = [f"benchdiff: {base_name} -> {cand_name} "
+             f"(threshold {threshold_pct:g}%)"]
+    if not result["rows"]:
+        lines.append("no comparable metrics (different bench modes?)")
+        return "\n".join(lines)
+    w = max(len(r[0]) for r in result["rows"])
+    for path, b, c, delta, verdict in result["rows"]:
+        lines.append(f"{path.ljust(w)}  {b:>12g}  {c:>12g}  "
+                     f"{delta:>+8.2f}%  {verdict}")
+    n = len(result["regressions"])
+    lines.append(f"{n} regression{'s' if n != 1 else ''}, "
+                 f"{result['comparable']} comparable metric"
+                 f"{'s' if result['comparable'] != 1 else ''}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools.benchdiff",
+        description="diff two BENCH_*.json artifacts; exit 1 on a "
+                    "percent regression past the threshold")
+    p.add_argument("baseline", help="baseline artifact (the committed one)")
+    p.add_argument("candidate", help="candidate artifact (the new run)")
+    p.add_argument("--threshold", type=float, default=5.0,
+                   help="percent movement in the bad direction that fails "
+                        "(default 5)")
+    args = p.parse_args(argv)
+    artifacts = []
+    for path in (args.baseline, args.candidate):
+        try:
+            with open(path) as f:
+                text = f.read()
+            try:
+                # bench_sweep artifacts: one indented JSON document
+                artifacts.append(json.loads(text))
+            except ValueError:
+                # bench.py artifacts: JSON-lines; the first line is the run
+                artifacts.append(json.loads(
+                    text.lstrip().splitlines()[0]))
+        except (OSError, ValueError, IndexError) as e:
+            print(f"benchdiff: cannot read {path}: {e}", file=sys.stderr)
+            return 2
+    result = diff(artifacts[0], artifacts[1], args.threshold)
+    print(render(result, args.baseline, args.candidate, args.threshold))
+    if not result["rows"]:
+        return 2
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

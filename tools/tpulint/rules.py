@@ -920,3 +920,101 @@ def r10_device_metrics(project: Project) -> List[Finding]:
             "have exactly one writer (the devmon export step) so the "
             "scraped value cannot depend on code-path ordering"))
     return out
+
+
+# ---------------------------------------------------------------------------
+# R11: tpu_capacity_* signals — both-route rendering + single-writer export
+# ---------------------------------------------------------------------------
+
+
+@rule("R11", "tpu_capacity_* rendered on both /metrics routes, one writer")
+def r11_capacity_metrics(project: Project) -> List[Finding]:
+    """The capacity/saturation signal plane (serving/capacity.py) carries
+    the same stricter contract R10 enforces for device telemetry, because
+    its gauges feed SCALING decisions — a fleet view and a replica view
+    that disagree about offered load or headroom produce contradictory
+    replica recommendations:
+
+    1. every metric set registering a ``tpu_capacity_*`` name must be
+       rendered by BOTH the engine server's and the router's ``/metrics``
+       routes;
+    2. each ``tpu_capacity_*`` metric attribute may be WRITTEN
+       (``inc/set/add/observe`` through a ``*.metrics.<attr>`` chain) from
+       at most one function across serving/ — the whole signal set is a
+       consistent point-in-time snapshot derived in one export step
+       (``CapacityEstimator.export()``), never updated piecemeal;
+    3. that single writer site must live in the file that DEFINES the
+       capacity metric set — an exporter elsewhere (a route handler
+       setting a capacity gauge inline) splits the snapshot across
+       modules and silently bypasses the drop-not-fail export guard.
+
+    Same resolution approximations as R2/R10 (``_resolve_owner``); writer
+    sites are keyed by (file, enclosing function)."""
+    out: List[Finding] = []
+    classes = _collect_metric_classes(project)
+    cap_classes = {
+        name: mc for name, mc in classes.items()
+        if any(n.startswith("tpu_capacity_") for n in mc.attrs.values())}
+    if not cap_classes:
+        return out
+
+    # (1) both routes must render every capacity metric set
+    server = project.get("serving/server.py")
+    router = project.get("serving/router.py")
+    if server is not None and router is not None:
+        server_owned = {_resolve_owner(c, server, project, classes)
+                        for c in _render_owners(server)}
+        router_owned = {_resolve_owner(c, router, project, classes)
+                        for c in _render_owners(router)}
+        for mc in sorted(cap_classes.values(), key=lambda m: m.name):
+            missing = [r for r, owned in (("server", server_owned),
+                                          ("router", router_owned))
+                       if mc.name not in owned]
+            if missing:
+                out.append(Finding(
+                    "R11", mc.file.rel, mc.lineno,
+                    f"capacity metric set {mc.name} (tpu_capacity_* names) "
+                    f"is not rendered by the {' and '.join(missing)} "
+                    "/metrics route(s) — the fleet scrape and the replica "
+                    "scrape must expose the same scaling signals"))
+
+    # (2)+(3) exactly one writer site, in the defining file
+    cap_attrs = {attr: mc.file.rel
+                 for mc in cap_classes.values()
+                 for attr, n in mc.attrs.items()
+                 if n.startswith("tpu_capacity_")}
+    writers: Dict[str, List[Tuple[str, str, int]]] = {}
+    for f in project.serving_files():
+        for node, ancestors in _walk_with_stack(f.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_OPS):
+                continue
+            chain = attr_chain(node.func.value)
+            if (len(chain) < 2 or chain[-2] != "metrics"
+                    or chain[-1] not in cap_attrs):
+                continue
+            encl = _enclosing_funcdef(ancestors)
+            writers.setdefault(chain[-1], []).append(
+                (f.rel, encl.name if encl else "<module>", node.lineno))
+    for attr in sorted(writers):
+        sites = sorted({(path, fn) for path, fn, _ in writers[attr]})
+        if len(sites) > 1:
+            path, fn, lineno = max(writers[attr], key=lambda s: (s[0], s[2]))
+            others = ", ".join(f"{p}:{f}" for p, f in sites)
+            out.append(Finding(
+                "R11", path, lineno,
+                f"capacity metric attribute '{attr}' is written from "
+                f"{len(sites)} sites ({others}) — tpu_capacity_* signals "
+                "must have exactly one writer (the capacity export step) "
+                "so a scrape is one consistent snapshot"))
+            continue
+        path, fn, lineno = writers[attr][0]
+        if path != cap_attrs[attr]:
+            out.append(Finding(
+                "R11", path, lineno,
+                f"capacity metric attribute '{attr}' is written from "
+                f"{path}:{fn} but its metric set is defined in "
+                f"{cap_attrs[attr]} — the single writer must be that "
+                "module's export step (drop-not-fail guard included)"))
+    return out

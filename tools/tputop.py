@@ -27,7 +27,7 @@ import urllib.error
 import urllib.request
 
 COLUMNS = ("replica", "st", "tok/s", "act", "que", "pages", "bub%",
-           "hbm", "mfu", "duty%", "burn5m", "last anomaly")
+           "hbm", "mfu", "duty%", "cap", "sat", "burn5m", "last anomaly")
 
 # burn column position (header logic keys off it; keep derived so the
 # device-panel columns can move without silently breaking the BURNING scan)
@@ -36,6 +36,12 @@ BURN_COL = COLUMNS.index("burn5m")
 # worst 5m burn >= this renders as BURNING in the header (the Google-SRE
 # "burning exactly the budget" line; the page-now threshold is 14.4)
 BURN_WARN = 1.0
+
+# utilization samples per replica kept for the ``sat`` sparkline (watch mode
+# feeds one per refresh; --once and routerless one-shots render a single tick)
+SPARK_WIDTH = 8
+# ascii-only ramp, same portability bar as the rest of the dashboard
+SPARK_RAMP = " .:-=+*#"
 
 
 def fetch_fleet(router_url: str, timeout: float = 5.0) -> dict:
@@ -93,7 +99,43 @@ def _hbm_bar(dev: dict, width: int = 5) -> str:
     return "#" * filled + "-" * (width - filled) + f" {100 * frac:.0f}%{warn}"
 
 
-def _row(addr: str, ent: dict) -> list:
+def _cap_bar(cap, width: int = 5) -> str:
+    """Headroom bar: offered load over the service ceiling (the capacity
+    estimator's utilization), with a trailing ``!`` once the replica reports
+    saturated. A replica whose /healthz predates serving/capacity.py (mixed
+    version fleet mid-rollout) renders ``-``, not a crash."""
+    if not isinstance(cap, dict):
+        return "-"
+    util = cap.get("utilization")
+    if util is None:
+        return "-"
+    try:
+        frac = min(1.0, max(0.0, float(util)))
+    except (TypeError, ValueError):
+        return "-"
+    warn = "!" if cap.get("saturated") else ""
+    filled = int(round(frac * width))
+    return "#" * filled + "-" * (width - filled) + f" {100 * frac:.0f}%{warn}"
+
+
+def _sat_spark(hist) -> str:
+    """Utilization history as an ascii sparkline (newest on the right).
+    Watch mode appends one sample per refresh; with no history (one-shot,
+    pre-capacity replica) a single tick or ``-`` renders instead."""
+    if not hist:
+        return "-"
+    out = []
+    top = len(SPARK_RAMP) - 1
+    for u in list(hist)[-SPARK_WIDTH:]:
+        try:
+            frac = min(1.0, max(0.0, float(u)))
+        except (TypeError, ValueError):
+            frac = 0.0
+        out.append(SPARK_RAMP[int(round(frac * top))])
+    return "".join(out)
+
+
+def _row(addr: str, ent: dict, hist=None) -> list:
     h = ent.get("health") or {}
     status = h.get("status", "?")
     if ent.get("cooling"):
@@ -110,6 +152,10 @@ def _row(addr: str, ent: dict) -> list:
     dev = h.get("device") or {}
     mfu = dev.get("mfu")
     duty = dev.get("duty_cycle")
+    cap = h.get("capacity")
+    if hist is None and isinstance(cap, dict) \
+            and cap.get("utilization") is not None:
+        hist = [cap["utilization"]]
     burn, obj = _worst_burn(h.get("slo"))
     anomaly = "-"
     last = (h.get("flight") or {}).get("last_anomaly")
@@ -125,14 +171,20 @@ def _row(addr: str, ent: dict) -> list:
             _hbm_bar(dev),
             "-" if mfu is None else f"{mfu:.2f}",
             "-" if duty is None else f"{100.0 * duty:.0f}",
+            _cap_bar(cap),
+            _sat_spark(hist),
             f"{burn:.2f}" + (f" {obj}" if obj and burn >= BURN_WARN else ""),
             anomaly]
 
 
-def render(fleet: dict) -> str:
-    """One dashboard frame from a /debug/fleet dict — pure, testable."""
+def render(fleet: dict, caphist: dict | None = None) -> str:
+    """One dashboard frame from a /debug/fleet dict — pure, testable.
+    ``caphist`` maps replica addr -> recent utilization samples (the watch
+    loop's sparkline feed); None falls back to the current sample alone."""
     replicas = fleet.get("replicas") or {}
-    rows = [_row(addr, replicas[addr] or {}) for addr in sorted(replicas)]
+    rows = [_row(addr, replicas[addr] or {},
+                 hist=(caphist or {}).get(addr))
+            for addr in sorted(replicas)]
     widths = [len(c) for c in COLUMNS]
     for r in rows:
         widths = [max(w, len(str(v))) for w, v in zip(widths, r)]
@@ -174,14 +226,25 @@ def main(argv=None) -> int:
     if not args.router and not args.replicas:
         p.error("one of --router or --replicas is required")
 
+    # addr -> recent utilization samples (the ``sat`` sparkline; watch mode
+    # appends one per refresh, bounded at SPARK_WIDTH)
+    caphist: dict = {}
+
     def frame() -> str:
         if args.replicas:
-            return render(fetch_replicas(
-                [a.strip() for a in args.replicas.split(",") if a.strip()]))
-        url = args.router
-        if "://" not in url:
-            url = "http://" + url
-        return render(fetch_fleet(url))
+            fleet = fetch_replicas(
+                [a.strip() for a in args.replicas.split(",") if a.strip()])
+        else:
+            url = args.router
+            if "://" not in url:
+                url = "http://" + url
+            fleet = fetch_fleet(url)
+        for addr, ent in (fleet.get("replicas") or {}).items():
+            cap = ((ent or {}).get("health") or {}).get("capacity")
+            if isinstance(cap, dict) and cap.get("utilization") is not None:
+                caphist.setdefault(addr, []).append(cap["utilization"])
+                del caphist[addr][:-SPARK_WIDTH]
+        return render(fleet, caphist=caphist)
 
     if args.once:
         print(frame())
