@@ -6,8 +6,8 @@ PY ?= python
 
 .PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests \
 	overload-smoke resume-smoke reconcile-smoke trace-smoke lint \
-	locksan-smoke aot-smoke pipeline-smoke flight-smoke devmon-smoke \
-	capacity-smoke bench-diff
+	locksan-smoke aot-smoke pipeline-smoke ragged-smoke flight-smoke \
+	devmon-smoke capacity-smoke bench-diff bench-ragged
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -123,6 +123,25 @@ locksan-smoke:
 pipeline-smoke:
 	env JAX_PLATFORMS=cpu TPU_LOCKSAN=1 $(PY) -m pytest \
 		tests/test_decode_pipeline.py -q -p no:cacheprovider
+
+# Ragged mixed-batch attention smoke (ops/pallas_attention.py ragged paged
+# kernel + serving/programs.py mixed_step): interleaved chunked-prefill
+# admissions must hold the pipeline open (zero admission-edge drains on
+# tpu_serve_pipeline_drains_total), seeded streams byte-identical ragged vs
+# legacy across sampled/logprobs/penalties, and the injected
+# ragged_dispatch_error fault drops the dispatch without killing the
+# engine. LockSan-instrumented for the same single-writer reason as
+# pipeline-smoke; tier-1 runs the same tests (marker ragged_smoke) bare.
+ragged-smoke:
+	env JAX_PLATFORMS=cpu TPU_LOCKSAN=1 $(PY) -m pytest tests/ -q \
+		-m ragged_smoke -p no:cacheprovider
+
+# Chip-free ragged A/B (bench.py --ragged): chunked-prefill-heavy mixed
+# load, ragged_attention=1 vs the sync fallback in one process. Asserts the
+# ragged pass matches-or-beats sync tok/s with ZERO admission-edge drains
+# and writes BENCH_ragged_r01.json.
+bench-ragged:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --ragged
 
 # AOT registry smoke (serving/aot.py): deviceless host-platform compile of
 # the full tiny-config program set through build_manifest — manifest schema

@@ -613,6 +613,16 @@ class ServingConfig:
     # position-derived). 0 restores the strictly synchronous dispatch→fetch
     # path (debugging, exact wall-clock attribution per dispatch).
     decode_pipeline: int = 1
+    # Ragged mixed-batch attention: chunked prefill rides the same program
+    # as the decode batch (one ragged dispatch packs the chunk's tokens
+    # alongside every decode row against the paged pool), so admissions no
+    # longer drain the one-deep pipeline and the chunk/decode alternation
+    # disappears. Requires paged + decode_pipeline; auto-falls-back to the
+    # legacy serialized chunk path for spec decode, LoRA, guided slots,
+    # dp/sp meshes, or a draining engine. 0 restores the legacy path
+    # everywhere (sync escape hatch; seeded streams are byte-identical
+    # either way).
+    ragged_attention: int = 1
     # Paged KV cache geometry.
     page_size: int = 64
     # True paged KV (vLLM's on-demand block allocation; serving/paged_kv.py):
@@ -905,6 +915,10 @@ def ansible_vars(cfg: FrameworkConfig | None = None,
     # Decode pipeline depth (perf_opt r9): the manifest passes it to
     # --decode-pipeline so a fleet can A/B or pin the synchronous path.
     d["serving_decode_pipeline"] = cfg.serving.decode_pipeline
+    # Ragged mixed-batch attention (ISSUE 14): threaded to
+    # --ragged-attention so a fleet can A/B the one-program mixed path
+    # against the legacy serialized chunk walk.
+    d["serving_ragged_attention"] = cfg.serving.ragged_attention
     # Robustness knobs (r7): the manifests pass these to the engine CLI so
     # the deadline/admission behavior is deploy-configurable from the same
     # single source.

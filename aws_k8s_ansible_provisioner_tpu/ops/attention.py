@@ -631,6 +631,116 @@ def make_spec_attend_carry_paged(lengths: jnp.ndarray, table: jnp.ndarray,
         return ctx, (pool, layer)
 
     return attend
+
+
+def make_mixed_attend_carry_paged(write_rows: jnp.ndarray,
+                                  row_limits: jnp.ndarray,
+                                  row_tables: jnp.ndarray,
+                                  impl: str = "auto", mesh=None,
+                                  window: int = 0, bblock: int = 1):
+    """RAGGED mixed-batch attend over the PAGED pool: the packed sequence
+    holds B single-token decode rows followed by C prefill-chunk rows of one
+    chunking slot, and ONE program serves them all (serving/programs
+    .mixed_step — the dispatch that lets the decode pipeline ride across
+    prefill admissions instead of draining).
+
+    Per packed row i the caller provides:
+    - ``write_rows`` [N]: the pool row this token's K/V lands at (decode:
+      the slot's context length; chunk row at position p: p; -1 DROPS the
+      write — used to suppress the chunking slot's garbage decode row);
+    - ``row_limits`` [N]: live columns the row attends over (decode:
+      context + 1; chunk: p + 1 — plain causality);
+    - ``row_tables`` [N, max_pages]: the page run of the slot row i belongs
+      to (chunk rows repeat the chunking slot's run).
+
+    All N writes land before any row attends; causality then reduces to the
+    per-row column mask, so a chunk row sees exactly its prefix (earlier
+    chunks + this chunk's earlier rows) and a decode row sees exactly its
+    own slot — byte-identical math to the separate decode_attend/
+    chunk_attend programs it replaces. Mesh support mirrors
+    make_decode_attend_carry_paged's tp sharding (heads over ``tp``); the
+    engine gates ragged dispatch to mesh None / pure-tp, so no dp rebase
+    rides here."""
+    resolved = resolve_impl(impl)
+
+    def _write_attend_mixed(q3, pool, knew, vnew, wrows, limits, tabs,
+                            layer):
+        from aws_k8s_ansible_provisioner_tpu.ops import pallas_attention
+
+        interpret = jax.default_backend() != "tpu"
+        ck, cv = pool["k"], pool["v"]
+        if "ks" in pool:
+            ck, ks = pallas_attention.cache_write_row_quant_paged(
+                ck, pool["ks"], knew, wrows, tabs, layer,
+                interpret=interpret)
+            cv, vs = pallas_attention.cache_write_row_quant_paged(
+                cv, pool["vs"], vnew, wrows, tabs, layer,
+                interpret=interpret)
+            pool = {"k": ck, "v": cv, "ks": ks, "vs": vs}
+            scale_kw = dict(pool_ks=ks, pool_vs=vs)
+        else:
+            ck = pallas_attention.cache_write_row_paged(
+                ck, knew, wrows, tabs, layer, interpret=interpret)
+            cv = pallas_attention.cache_write_row_paged(
+                cv, vnew, wrows, tabs, layer, interpret=interpret)
+            pool = {"k": ck, "v": cv}
+            scale_kw = {}
+        ctx = pallas_attention.ragged_attend_pallas_paged(
+            q3, ck, cv, limits, layer, tabs, interpret=interpret,
+            window=window, bblock=bblock, **scale_kw)
+        return ctx, pool
+
+    def attend(q, k, v, cache_l) -> Tuple[jnp.ndarray, tuple]:
+        from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
+
+        pool, layer = cache_l
+        ps = pool["k"].shape[3]
+        if resolved == "pallas":
+            # packed layout: batch axis is 1, rows live on the seq axis
+            q3, knew, vnew = q[0], k[0], v[0]        # [N, H*, D]
+            if mesh is not None:
+                from jax.experimental.shard_map import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                from aws_k8s_ansible_provisioner_tpu.parallel.sharding import (
+                    pool_pspecs)
+
+                pool_spec = pool_pspecs(quant="ks" in pool)
+                fn = shard_map(
+                    _write_attend_mixed, mesh=mesh,
+                    in_specs=(P(None, "tp", None),    # q3 [N,Hq,D]
+                              pool_spec,              # pool leaf dict
+                              P(None, "tp", None),    # knew [N,Hkv,D]
+                              P(None, "tp", None),    # vnew
+                              P(None),                # write_rows [N]
+                              P(None),                # row_limits [N]
+                              P(None, None),          # row_tables
+                              P()),                   # layer scalar
+                    out_specs=(P(None, "tp", None), pool_spec),
+                    check_rep=False,
+                )
+                ctx, pool = fn(q3, pool, knew, vnew, write_rows,
+                               row_limits, row_tables, layer)
+            else:
+                ctx, pool = _write_attend_mixed(q3, pool, knew, vnew,
+                                                write_rows, row_limits,
+                                                row_tables, layer)
+            return ctx[None], (pool, layer)
+        pool = pkv.write_token_layer_paged(pool, layer, write_rows,
+                                           row_tables, k[0][:, None],
+                                           v[0][:, None], ps)
+        dense = pkv.gather_layer_dense(pool, layer, row_tables)
+        ck, cv = dense["k"], dense["v"]
+        if "ks" in dense:
+            ck = kvc.dequantize(ck, dense["ks"], dtype=q.dtype)
+            cv = kvc.dequantize(cv, dense["vs"], dtype=q.dtype)
+        ctx = decode_attend(q[0][:, None], ck, cv, row_limits,
+                            window=window)
+        return ctx[:, 0][None], (pool, layer)
+
+    return attend
+
+
 def make_prefill_attend_paged_carry(pages: jnp.ndarray, seq_len: jnp.ndarray,
                                     window: int = 0):
     """CARRY-path paged single-prompt prefill: the full pool rides the layer

@@ -860,6 +860,14 @@ def cache_write_row_quant(cache: jnp.ndarray, scales: jnp.ndarray,
 # with the Ragged Paged Attention amortization argument (PAPERS.md): the
 # page gather is done by the DMA engine, overlapped, in block-sized batches.
 #
+# The body's contract is RAGGED: a grid row is an arbitrary (table row,
+# live-column limit) pair, not intrinsically "slot i decoding". The
+# per-slot decode/spec entry points below are the identity-indirection
+# special case; ragged_attend_pallas_paged exposes the general form — a
+# packed mix of decode rows and prefill-chunk rows served by ONE dispatch
+# (serving/programs.mixed_step rides it to keep the decode pipeline open
+# across prefill admissions).
+#
 # ``bblock`` (BB) is the knob the engine autotunes at startup
 # (Engine._resolve_decode_bblock: one-shot microbench over {1, 4, 8} per
 # (batch, page_size, kv_dtype)); 1 remains valid and still double-buffers.
@@ -1106,6 +1114,55 @@ def decode_attend_pallas_paged(q: jnp.ndarray, pool_k: jnp.ndarray,
         bb=_resolve_bb(bblock, B), R=1, spec=False, window=window,
         interpret=interpret, pool_ks=pool_ks, pool_vs=pool_vs)
     return out[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "window", "bblock"))
+def ragged_attend_pallas_paged(q: jnp.ndarray, pool_k: jnp.ndarray,
+                               pool_v: jnp.ndarray, row_limits: jnp.ndarray,
+                               layer: jnp.ndarray, row_tables: jnp.ndarray,
+                               interpret: bool = False,
+                               pool_ks: jnp.ndarray = None,
+                               pool_vs: jnp.ndarray = None,
+                               window: int = 0,
+                               bblock: int = 1) -> jnp.ndarray:
+    """RAGGED paged flash attention: N query-token-packed rows, each with its
+    OWN (page table row, live-column count) — one program serves a mixed
+    batch of single-token decode rows and prefill-chunk rows in a single
+    dispatch (PAPERS.md "Ragged Paged Attention").
+
+    The key move is that the double-buffered body (_paged_db_body) never
+    cared that row i belonged to slot i — its math is entirely driven by the
+    (table row, limit) pair it is handed per query row. Lifting the table to
+    PER-ROW indirection (``row_tables`` [N, max_pages]: row i holds the page
+    run of whatever slot row i queries) turns the per-slot decode kernel
+    into a variable-length-rows kernel with zero changes to the flash
+    accumulation, the page-clamp raggedness handling, or the two-slot DMA
+    pipeline:
+
+    - a DECODE row carries its slot's table row and limit = context + 1;
+    - a PREFILL-CHUNK row at position p carries the chunking slot's table
+      row and limit = p + 1 (plain causality), so C chunk rows of one slot
+      pack alongside B decode rows of B other slots and every row masks to
+      exactly its own live columns. Chunk rows of the same slot landing in
+      one bblock-wide grid step fetch the same pages — the block's page
+      stream amortizes over them exactly as it does over decode neighbors.
+
+    q: [N, Hq, D] packed query rows; row_limits: [N] live columns per row;
+    row_tables: [N, max_pages] int32 (entries at or past a row's live range
+    may be any valid id — clamped away, never fetched); layer: scalar.
+    Returns [N, Hq, D]. pool_ks/vs switch the int8 scale-folding body;
+    ``window`` > 0 applies per-row sliding-window masking off each row's own
+    limit. ``bblock`` packed rows share each grid step (resolved to the
+    largest divisor of N).
+    """
+    N = q.shape[0]
+    row_limits = row_limits.astype(jnp.int32)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    return _paged_flash_db(
+        q, pool_k, pool_v, row_limits, layer_arr,
+        row_tables.astype(jnp.int32),
+        bb=_resolve_bb(bblock, N), R=1, spec=False, window=window,
+        interpret=interpret, pool_ks=pool_ks, pool_vs=pool_vs)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "window", "bblock"))

@@ -228,8 +228,8 @@ def enumerate_programs(plan, mesh, params, cache, bblock: int = 1):
     import jax.numpy as jnp
 
     from aws_k8s_ansible_provisioner_tpu.serving.programs import (
-        BAN_K, BIAS_K, decode_steps, prefill_batch_step, prefill_chunk_step,
-        prefill_step, spec_decode_step)
+        BAN_K, BIAS_K, decode_steps, mixed_step, prefill_batch_step,
+        prefill_chunk_step, prefill_step, spec_decode_step)
 
     cfg, serving = plan.cfg, plan.serving
     B, pps = plan.num_slots, plan.pages_per_slot
@@ -316,6 +316,23 @@ def enumerate_programs(plan, mesh, params, cache, bblock: int = 1):
                      decode_args, decode_kwargs(penalties=True)))
     programs.append((f"decode_fused_h{plan.horizon}_logprobs", decode_steps,
                      decode_args, decode_kwargs(logprobs=True)))
+    if (plan.paged and serving.ragged_attention > 0
+            and serving.decode_pipeline > 0 and not serving.spec_decode):
+        # Ragged mixed-batch program (ISSUE 14): one dispatch serves a
+        # prefill chunk packed alongside every decode row. Operand layout
+        # mirrors EnginePrograms._mixed_dispatch exactly.
+        programs.append((
+            f"mixed_c{plan.chunk}", mixed_step,
+            (cfg, params, cache, sds((B,), i32), sds((B,), i32),
+             sds((1, plan.chunk), i32), scalar, scalar, scalar,
+             sds((), f32), sds((cfg.vocab_size,), jnp.bool_),
+             sds((), u32), sds((), f32), scalar, sds((), f32), rng,
+             sds((B,), f32), sds((B,), i32), sds((B,), f32)),
+            dict(mesh=mesh, impl=serving.attention_impl,
+                 table=sds((B, pps), i32), seeds=sds((B,), u32),
+                 ban_ids=sds((B, BAN_K), i32), ban_until=sds((B,), i32),
+                 bias_ids=sds((B, BIAS_K), i32),
+                 bias_vals=sds((B, BIAS_K), f32), bblock=bblock)))
     if plan.spec_rows:
         R = plan.spec_rows
         programs.append((

@@ -96,7 +96,8 @@ from typing import Dict, Optional
 FAULTS = ("connect_refused", "stalled_decode", "page_exhaustion",
           "slow_client", "mid_stream_disconnect", "kill_stream",
           "stream_read_error", "span_export", "pipeline_fetch_error",
-          "flight_dump_error", "capacity_export_error")
+          "ragged_dispatch_error", "flight_dump_error",
+          "capacity_export_error")
 
 
 class InjectedFault(RuntimeError):
@@ -233,6 +234,20 @@ class ChaosController:
             return
         raise InjectedFault(
             "chaos: injected pipelined decode fetch failure")
+
+    def on_mixed_fetch(self, engine) -> None:
+        """EnginePrograms._decode_fetch entry for RAGGED MIXED records
+        only: an armed ``ragged_dispatch_error`` raises at the blocking
+        read of a mixed (prefill-chunk + decode) dispatch. The in-flight
+        record is discarded, the chunk walk's error path releases the
+        half-prefilled slot's pages exactly once (it clears ``_chunk``
+        before re-raising, so _fail_all cannot release it a second time),
+        and the engine keeps serving."""
+        p = self.fire("ragged_dispatch_error")
+        if p is None:
+            return
+        raise InjectedFault(
+            "chaos: injected ragged mixed-dispatch failure")
 
     def on_engine_step(self, engine) -> None:
         """engine.step entry: an armed ``page_exhaustion`` makes the page

@@ -55,7 +55,7 @@ DEFAULT_HBM_TOLERANCE_MB = 64.0
 # Program kinds the engine reports — the label set is closed so the gauge
 # cardinality is bounded no matter what traffic does.
 PROGRAM_KINDS = ("prefill", "prefill_batch", "prefill_chunk", "prefix_copy",
-                 "decode", "spec_decode")
+                 "decode", "spec_decode", "mixed_step")
 
 
 class DevMonMetrics:
@@ -150,6 +150,12 @@ class CostModel:
         if kind == "prefix_copy":
             return 0.0, 2.0 * tokens * self.kv_row_bytes
         flops = self.flops_per_token * tokens
+        if kind == "mixed_step":
+            # ragged mixed batch: weights stream once for BOTH the decode
+            # rows and the packed prefill chunk (the fusion's bandwidth
+            # win); decode rows read their context, chunk rows write theirs
+            return flops, (self.weight_bytes
+                           + tokens * ctx_rows * self.kv_row_bytes)
         if kind in ("decode", "spec_decode"):
             byts = steps * self.weight_bytes \
                 + tokens * ctx_rows * self.kv_row_bytes

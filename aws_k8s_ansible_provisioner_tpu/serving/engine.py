@@ -46,6 +46,7 @@ from aws_k8s_ansible_provisioner_tpu.serving import capacity as _capacity
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
 from aws_k8s_ansible_provisioner_tpu.serving import devmon as _devmon
 from aws_k8s_ansible_provisioner_tpu.serving import flightrec as _flight
+from aws_k8s_ansible_provisioner_tpu.serving import metrics as _metrics
 from aws_k8s_ansible_provisioner_tpu.serving import slo as _slo
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import EngineMetrics
 from aws_k8s_ansible_provisioner_tpu.serving.programs import (  # noqa: F401
@@ -1133,6 +1134,13 @@ class Engine(EnginePrograms):
         # in-flight streams keep progressing during the prefill (the whole
         # point of chunking — VERDICT r1 missing #4).
         if self._chunk is not None:
+            if self._chunk.get("mixed"):
+                # Ragged mixed walk: every chunk dispatch IS a decode
+                # dispatch for the whole batch (one program serves both),
+                # so the chunk/decode alternation — and the horizon-1
+                # garbage-row caveat it exists for — doesn't apply.
+                self._advance_chunk()
+                return True
             if self._chunk_yield and self._active_slots():
                 self._chunk_yield = False
                 # horizon must be 1 while chunking: the decode program writes
@@ -1160,9 +1168,18 @@ class Engine(EnginePrograms):
         # Pipelined decode: settle the in-flight dispatch (its deferred
         # emits, possible finishes) BEFORE admission can reuse a freed slot
         # or start a chunk — slot reuse under unfetched tokens would
-        # mis-route the deferred emits to the new request.
-        if self._inflight is not None and self.sched.stats().queue_depth > 0:
-            self._drain_decode_pipeline()
+        # mis-route the deferred emits to the new request. With the ragged
+        # mixed path on, admission under an in-flight dispatch is forced
+        # onto the chunk walk (below), which keeps the carry valid and
+        # never activates a slot before the dispatch settles — so the
+        # pipeline stays open across admissions (the whole point of the
+        # ragged program; deferred emits for a freed slot are discarded by
+        # the slot_req-is-None guard in _decode_fetch, never mis-routed,
+        # because _activate only runs after the in-flight fetch).
+        if (self._inflight is not None
+                and self.sched.stats().queue_depth > 0
+                and not self._ragged_on()):
+            self._drain_decode_pipeline("prefill")
         # Admission decisions come from the runtime core (FCFS; skips
         # cancelled-in-queue requests, surfacing them for client notification).
         # Bucket-fitting prompts batch into one dispatch; a chunk-needing
@@ -1222,8 +1239,14 @@ class Engine(EnginePrograms):
                     break
                 ids, off, resumed = prep
                 # prefix reuse and resumes walk the chunk program from the
-                # reuse offset; fresh bucket-sized prompts join the batch
-                if off > 0 or resumed or self._should_chunk(req):
+                # reuse offset; fresh bucket-sized prompts join the batch.
+                # With a dispatch in flight on the ragged path, EVERY
+                # admission takes the chunk walk: the mixed program prefills
+                # it without draining the pipeline, where a batch prefill
+                # would activate slots under the in-flight carry.
+                if (off > 0 or resumed or self._should_chunk(req)
+                        or (self._ragged_on()
+                            and self._inflight is not None)):
                     chunk_next = (req, slot, ("paged", ids, off, resumed))
                     break
                 batch.append((req, slot))
@@ -1468,6 +1491,8 @@ class Engine(EnginePrograms):
         # failed below through the normal slot teardown (exactly-once page/
         # slot release via _finish), and fetching a dispatch that may BE the
         # failure (pipeline_fetch_error, transfer fault) would re-raise.
+        if self._inflight is not None:
+            _metrics.pipeline.drains.inc(reason="fail")
         self._inflight = None
         self._pipe_carry = None
         self.metrics.pipeline_depth.set(0.0)

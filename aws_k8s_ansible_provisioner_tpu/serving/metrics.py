@@ -323,3 +323,55 @@ class EngineMetrics:
         from aws_k8s_ansible_provisioner_tpu.serving import slo as _slo
 
         _slo.get().observe_request(status, duration_s)
+
+
+class PipelineMetrics:
+    """Process-wide decode-pipeline health counters, shared by every engine
+    in the process and rendered by BOTH /metrics routes (engine server and
+    router) — same singleton pattern as flightrec/slo/devmon.
+
+    The decode pipeline's whole value is staying ON under mixed traffic
+    (PERF.md): every drain discharges the in-flight dispatch early and the
+    next decode pays the full host bubble again. This counter makes the
+    ragged-attention win — mixed prefill+decode steps riding the pipeline
+    instead of killing it — measurable in production, by reason:
+
+    - ``prefill``: a prefill admission / activation invalidated the carry
+      (the legacy per-admission drain the ragged path removes);
+    - ``chunk``:   a chunked-prefill walk forced the synchronous branch;
+    - ``spec``:    speculative decode needed current host mirrors;
+    - ``guided``:  a grammar-guided slot forced per-token dispatch;
+    - ``drain``:   engine drain / idle settle (intentional, not a loss);
+    - ``fail``:    a failed fetch discarded the in-flight dispatch.
+    """
+
+    def __init__(self):
+        self.registry = Registry()
+        r = self.registry
+        self.drains = r.register(Counter(
+            "tpu_serve_pipeline_drains_total",
+            "Decode-pipeline drains (in-flight dispatch discharged early), "
+            "by reason",
+            ("reason",)))
+        self.dispatches = r.register(Counter(
+            "tpu_serve_pipeline_dispatches_total",
+            "Decode/mixed dispatches enqueued (drain-rate denominator)"))
+
+    def snapshot(self) -> dict:
+        """Drain totals by reason + the drain rate (drains per dispatch) for
+        /healthz and tpu-top — the one number that says whether the pipeline
+        is actually staying open under the current traffic mix."""
+        with self.drains._lock:
+            by_reason = {(dict(key).get("reason") or "other"): int(val)
+                         for key, val in self.drains._values.items()}
+        total = sum(by_reason.values())
+        dispatched = self.dispatches.total()
+        return {
+            "drains_total": total,
+            "drains_by_reason": by_reason,
+            "dispatches_total": int(dispatched),
+            "drain_rate": round(total / dispatched, 4) if dispatched else 0.0,
+        }
+
+
+pipeline = PipelineMetrics()

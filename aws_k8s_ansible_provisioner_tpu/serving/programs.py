@@ -42,6 +42,7 @@ from aws_k8s_ansible_provisioner_tpu.ops.attention import (
     make_chunk_prefill_attend_paged_carry,
     make_decode_attend_carry,
     make_decode_attend_carry_paged,
+    make_mixed_attend_carry_paged,
     make_prefill_attend,
     make_prefill_attend_batch,
     make_prefill_attend_batch_paged_carry,
@@ -56,6 +57,7 @@ from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
 from aws_k8s_ansible_provisioner_tpu.serving import devmon as _devmon
 from aws_k8s_ansible_provisioner_tpu.serving import flightrec as _flight
 from aws_k8s_ansible_provisioner_tpu.serving import kv_cache as kvc
+from aws_k8s_ansible_provisioner_tpu.serving import metrics as _metrics
 from aws_k8s_ansible_provisioner_tpu.serving import slo as _slo
 
 
@@ -511,6 +513,111 @@ def decode_steps(cfg: ModelConfig, n_steps: int, params, cache, tokens,
         (cache, counts, tok, lens), out = jax.lax.scan(
             body, (cache, counts, tokens, lengths), rngs)
     return cache, counts, out, tok, lens
+
+
+@partial(jax.jit, static_argnums=(0,),
+         static_argnames=("mesh", "impl", "logprobs", "chunk_logprobs",
+                          "penalties", "bblock"),
+         donate_argnums=(2, 3, 4), donate_argnames=("counts",))
+def mixed_step(cfg: ModelConfig, params, cache, tokens, lengths, ptokens,
+               pslot, pstart, plen, prep, prep_seen, pseed, ptemp, ptop_k,
+               ptop_p, rng, temperature, top_k, top_p, mesh=None,
+               impl: str = "auto", logprobs: bool = False,
+               chunk_logprobs: bool = False, counts=None, presence=None,
+               frequency=None, repetition=None, prompt_mask=None,
+               penalties: bool = False, table=None, seeds=None,
+               ban_ids=None, ban_until=None, bias_ids=None, bias_vals=None,
+               lora_idx=None, bblock: int = 1):
+    """ONE ragged dispatch serving a mixed batch: a decode step for every
+    active slot AND one prefill chunk of slot ``pslot`` — the program that
+    lets the one-deep pipeline ride across prefill admissions instead of
+    draining on every chunk edge (ISSUE 14 / ROADMAP open item 2; the
+    variable-length-rows layout follows Ragged Paged Attention, arxiv
+    2604.15464).
+
+    Layout: the forward pass runs ONCE over a query-token-packed sequence
+    ``[1, B + C]`` — B decode rows (token ``tokens[b]`` at position
+    ``lengths[b]``), then the C chunk rows of ``ptokens`` at positions
+    ``pstart + j``. MLP/norm/projections are per-token, so packing changes
+    nothing; attention goes through make_mixed_attend_carry_paged, whose
+    per-row (write row, live-column limit, page-table row) metadata gives
+    each packed row exactly the view the separate decode/chunk programs
+    gave it — byte-identical streams either way (pinned by
+    tests/test_decode_pipeline.py's ragged parity cases).
+
+    ``pslot``'s own decode row is a dead passenger while it chunks: its
+    K/V write is DROPPED (write row -1), it attends nothing (limit 0), and
+    the returned carry overrides its lanes with the chunk's sample
+    (``tok_out[pslot] = chunk token``, ``lens_out[pslot] = pstart + plen``)
+    so the device carry matches the host mirrors a final-chunk activation
+    produces — the generation-stamped carry extended to cover
+    prefill-admitted slots.
+
+    Sampling matches the programs it replaces exactly: decode rows take the
+    decode_steps transform order (penalties → bias → ban(lens) → seeded key
+    at lens + 1); the chunk's last valid row takes prefill_chunk_step's
+    (host rep_seen → bias → ban at pstart + plen → seeded key at
+    pstart + plen). Only the FINAL chunk's sample survives on the host.
+
+    Returns (cache, counts, out [1, B] (+logprobs), chunk token [1]
+    (+chunk logprobs), tok_carry [B], lens_carry [B]).
+    """
+    B = tokens.shape[0]
+    C = ptokens.shape[1]
+    is_p = jnp.arange(B, dtype=jnp.int32) == pslot
+    crows = pstart + jnp.arange(C, dtype=jnp.int32)
+    write_rows = jnp.concatenate(
+        [jnp.where(is_p, jnp.int32(-1), lengths), crows])
+    row_limits = jnp.concatenate(
+        [jnp.where(is_p, jnp.int32(0), lengths + 1), crows + 1])
+    row_tables = jnp.concatenate(
+        [table, jnp.broadcast_to(table[pslot][None], (C, table.shape[1]))])
+    packed = jnp.concatenate([tokens[None], ptokens], axis=1)     # [1, B+C]
+    positions = jnp.concatenate(
+        [jnp.where(is_p, jnp.int32(0), lengths)[None], crows[None]], axis=1)
+    attend = make_mixed_attend_carry_paged(
+        write_rows, row_limits, row_tables, impl=impl, mesh=mesh,
+        window=cfg.sliding_window, bblock=bblock)
+    with lora_context(lora_idx):
+        logits, cache = model_forward_carry(params, cfg, packed, positions,
+                                            cache, attend)
+    # -- decode rows: the decode_steps substep body, verbatim order --------
+    dec_logits = logits[0, :B]
+    if penalties:
+        dec_logits = apply_penalties(dec_logits, counts, presence, frequency,
+                                     repetition, prompt_mask)
+    dec_logits = _apply_logit_bias(dec_logits, bias_ids, bias_vals)
+    dec_logits = _mask_banned(dec_logits, ban_ids, ban_until, lengths)
+    keys = per_slot_keys(seeds, lengths + 1) if seeds is not None else rng
+    nxt = sample(dec_logits, keys, temperature, top_k, top_p)
+    if penalties:
+        # pslot's lane counts a garbage sample; _activate's count-row
+        # reset/restore at the final chunk wipes it (same policy as its
+        # stale-occupant rows)
+        counts = counts.at[jnp.arange(counts.shape[0]), nxt].add(1)
+    # -- chunk row: the prefill_chunk_step tail, verbatim order ------------
+    plast = jnp.take(logits[0, B:], plen - 1, axis=0)[None]       # [1, V]
+    if prep is not None and prep_seen is not None:
+        r = prep.astype(jnp.float32)
+        lf = plast.astype(jnp.float32)
+        plast = jnp.where(prep_seen[None],
+                          jnp.where(lf > 0, lf / r, lf * r), lf)
+    plast = _apply_logit_bias(plast, bias_ids[pslot][None],
+                              bias_vals[pslot][None])
+    plast = _mask_banned(plast, ban_ids[pslot][None], ban_until[pslot][None],
+                         (pstart + plen)[None])
+    pkeys = per_slot_keys(pseed[None], (pstart + plen)[None]) \
+        if pseed is not None else rng
+    ptok = sample(plast, pkeys, ptemp[None], ptop_k[None], ptop_p[None])
+    # -- regenerated carry: pslot's lanes become the chunk frontier --------
+    tok_out = jnp.where(is_p, ptok[0], nxt)
+    lens_out = jnp.where(is_p, pstart + plen, lengths + 1)
+    if counts is None:
+        counts = jnp.zeros((B, 1), jnp.int32)  # unused dummy (decode_steps)
+    out = (nxt[None], tuple(a[None] for a in _logprob_topk(dec_logits, nxt))) \
+        if logprobs else nxt[None]
+    pout = (ptok, _logprob_topk(plast, ptok)) if chunk_logprobs else ptok
+    return cache, counts, out, pout, tok_out, lens_out
 
 
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("impl", "mesh",
@@ -1321,9 +1428,27 @@ class EnginePrograms:
         prompt + generated for a preemption resume.
         """
         self._fill_sampling_rows(req, slot)   # before the first chunk dispatch
-        # chunking rewrites the slot's length out of band of any decode
-        # carry (admission already drained the pipeline; belt-and-braces)
-        self._carry_gen += 1
+        # Route the WHOLE walk once, here: the ragged mixed program pays for
+        # itself only when there are live decode rows to pack alongside (or
+        # an in-flight dispatch to keep open) — an idle engine's chunk walk
+        # uses the plain chunk program it already compiled, paying neither a
+        # mixed_step compile nor packed-row arithmetic for zero decode rows.
+        # Frozen at walk start: no admission/activation can happen mid-walk
+        # (engine.step services _chunk before admissions), so the conditions
+        # cannot flip under the walk — except draining, which both branches
+        # tolerate.
+        mixed = (self._ragged_on() and req.guided is None
+                 and (self._inflight is not None
+                      or bool(self._active_slots())))
+        if not mixed:
+            # chunking rewrites the slot's length out of band of any decode
+            # carry (admission already drained the pipeline; belt-and-braces)
+            self._carry_gen += 1
+        # else: ragged mixed walk — the in-flight carry STAYS valid. The
+        # chunking slot was inactive, so the in-flight dispatch's garbage
+        # row for it lands in scratch (its old device-side table row), and
+        # every mixed dispatch overrides the slot's carry lanes in-program
+        # (mixed_step's is_p masking) — nothing the carry describes changed.
         if self.draft is not None:
             # the draft has no chunk walk; the slot serves the plain path
             self.draft.mark_stale(slot)
@@ -1341,7 +1466,8 @@ class EnginePrograms:
             self.lengths[slot] = off
             self._chunk = {"req": req, "slot": slot, "off": off,
                            "C": self._chunk_size, "ids": ids,
-                           "resumed": resumed, "rep_seen": rep_seen}
+                           "resumed": resumed, "rep_seen": rep_seen,
+                           "mixed": mixed}
             return
         self._slot_tokens[slot] = ()   # rows about to be overwritten
         off = 0
@@ -1362,13 +1488,17 @@ class EnginePrograms:
             self.metrics.prefix_tokens_reused.inc(n)
         self.lengths[slot] = off
         self._chunk = {"req": req, "slot": slot, "off": off,
-                       "C": self._chunk_size, "rep_seen": rep_seen}
+                       "C": self._chunk_size, "rep_seen": rep_seen,
+                       "mixed": False}   # dense mode: _ragged_on is paged-only
 
     def _advance_chunk(self):
         """Dispatch the next chunk of the in-progress chunked prefill."""
         st = self._chunk
         req, slot = st["req"], st["slot"]
         if req.cancelled:
+            # settle any in-flight mixed dispatch BEFORE releasing this
+            # slot's pages: its deferred emits still reference the batch
+            self._drain_decode_pipeline("chunk")
             self._chunk = None
             self._release_slot_pages(slot)
             self.sched.release(slot)
@@ -1379,6 +1509,14 @@ class EnginePrograms:
             _flight.finish(req.id, "cancelled", ok=False)
             req.out_queue.put(None)
             return
+        if st.get("mixed"):
+            self._advance_chunk_mixed(st)
+            return
+        if self._inflight is not None:
+            # legacy walk with a dispatch in flight (ragged off, or the
+            # walk was routed legacy at start): settle it before the sync
+            # chunk dispatch rewrites slot state out from under its carry
+            self._drain_decode_pipeline("chunk")
         C = st["C"]
         ids = st.get("ids") or req.prompt_ids
         off = st["off"]
@@ -1435,6 +1573,146 @@ class EnginePrograms:
                 if req.logprobs is not None and lp_t is not None else None
             self._activate(req, slot, int(token), lp, ids=list(ids),
                            resumed=st.get("resumed", False))
+
+    def _advance_chunk_mixed(self, st: dict) -> None:
+        """One RAGGED mixed dispatch: this walk's next prefill chunk packed
+        alongside the whole decode batch, served by a single program
+        (``mixed_step``). The dispatch rides the one-deep pipeline exactly
+        like a plain decode — the in-flight record it leaves behind IS a
+        decode record (plus the chunk outputs), so the pipeline never
+        drains on a chunk edge. The legacy path pays one drain per
+        admission plus a serialized chunk dispatch per chunk; here both
+        costs go to zero.
+
+        The final chunk is the one exception: activation needs the sampled
+        first token immediately, so that dispatch settles synchronously.
+        Nothing is discarded early — both it and any in-flight predecessor
+        are fully emitted — so the drain counter does NOT move.
+        """
+        req, slot = st["req"], st["slot"]
+        C = st["C"]
+        ids = st.get("ids") or req.prompt_ids
+        off = st["off"]
+        chunk = ids[off:off + C]
+        final = off + len(chunk) >= len(ids)
+        _flight.record("prefill_chunk", req.id, off=off, n=len(chunk),
+                       mixed=True)
+        prev = self._inflight
+        if prev is not None and not self._carry_valid():
+            # a slot activated/preempted under the in-flight dispatch —
+            # same invalidation rule as _do_decode
+            self._drain_decode_pipeline("prefill")
+            prev = None
+        # Page headroom for the decode rows' writes (the chunk slot's pages
+        # were fully allocated at admission, and it is NOT in the active
+        # set, so _ensure_pages never preempts it). The bool return (any
+        # active slots left) is deliberately ignored: the chunk must
+        # proceed even with zero active decode rows.
+        grow = 1 + (prev["horizon"] if prev is not None else 0)
+        self._ensure_pages(grow)
+        if prev is not None and not self._carry_valid():
+            # _ensure_pages preempted under the in-flight dispatch
+            self._drain_decode_pipeline("prefill")
+            prev = None
+        if prev is not None:
+            tok_in, len_in = self._pipe_carry[0], self._pipe_carry[1]
+        else:
+            tok_in = self._donatable(self.last_token)
+            len_in = self._donatable(self.lengths)
+        try:
+            rec = self._mixed_dispatch(st, chunk, tok_in, len_in)
+            st["off"] = off + len(chunk)
+            self.lengths[slot] = st["off"]
+            if not final:
+                # steady state: leave the mixed dispatch in flight, settle
+                # its predecessor while the device runs this one
+                self._inflight = rec
+                self.metrics.pipeline_depth.set(1.0)
+                if prev is not None:
+                    self._decode_fetch(prev, tail=False)
+                return
+            # final chunk: settle in order — predecessor first, then this
+            # dispatch (whose chunk token activates the slot below)
+            self._pipe_carry = None
+            if prev is not None:
+                self._inflight = None
+                self.metrics.pipeline_depth.set(0.0)
+                self._decode_fetch(prev, tail=False)
+            self._decode_fetch(rec, tail=True)
+        except Exception:
+            # exactly-once release: clearing _chunk BEFORE the raise means
+            # the engine's failover (_fail_all) sees no chunk in progress
+            # and cannot release this slot a second time
+            self._chunk = None
+            self._release_slot_pages(slot)
+            self.sched.release(slot)
+            req.finish_reason = "error"
+            self.metrics.mark_request("error", 0.0)
+            req.out_queue.put(None)
+            raise
+        lp = _host_lp(rec["chunk_lp_t"], 0, req.logprobs) \
+            if rec["chunk_lp"] else None
+        self._chunk = None
+        self._activate(req, slot, rec["chunk_token"], lp, ids=list(ids),
+                       resumed=st.get("resumed", False))
+
+    def _mixed_dispatch(self, st: dict, chunk, tok_in, len_in) -> dict:
+        """Enqueue ONE ragged mixed dispatch (prefill chunk + decode batch)
+        and return its in-flight record. Async half only — no blocking
+        device reads here (tpulint R8); the transfer and emits happen in
+        _decode_fetch, which also unpacks the chunk-row outputs."""
+        req, slot, off = st["req"], st["slot"], st["off"]
+        ids = st.get("ids") or req.prompt_ids
+        active = [s for s in self._active_slots() if s != slot]
+        oc = self._decode_operands()
+        want_lp = self._want_logprobs(self.slot_req)
+        want_pen = self.counts is not None and bool(
+            self.pres_pens.any() or self.freq_pens.any()
+            or (self.rep_pens != 1.0).any())
+        chunk_lp = (req.logprobs is not None and not st.get("resumed")
+                    and off + len(chunk) >= len(ids))
+        tokens = np.zeros((1, st["C"]), np.int32)
+        tokens[0, :len(chunk)] = chunk
+        t0 = time.monotonic()
+        if self._last_ready > 0.0:
+            self.metrics.decode_bubble_seconds.inc(
+                max(0.0, t0 - self._last_ready))
+            self._last_ready = 0.0
+        real_counts = self.counts
+        self.cache, new_counts, out, pout, tok, lens = mixed_step(
+            self.cfg, self.params, self.cache, tok_in, len_in,
+            jnp.asarray(tokens), jnp.int32(slot), jnp.int32(off),
+            jnp.int32(len(chunk)),
+            jnp.float32(req.repetition_penalty or 1.0),
+            jnp.asarray(st["rep_seen"]), jnp.uint32(req.eff_seed),
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jnp.float32(req.top_p), self._next_rng(),
+            oc["temps"], oc["top_ks"], oc["top_ps"],
+            mesh=self.mesh, impl=self.serving.attention_impl,
+            logprobs=want_lp, chunk_logprobs=chunk_lp,
+            counts=self.counts if want_pen else None,
+            presence=oc["pres"] if want_pen else None,
+            frequency=oc["freq"] if want_pen else None,
+            repetition=oc["rep"] if want_pen else None,
+            prompt_mask=self.prompt_mask if want_pen else None,
+            penalties=want_pen,
+            table=oc["table"],
+            seeds=oc["seeds"],
+            ban_ids=oc["ban_ids"],
+            ban_until=oc["ban_until"],
+            bias_ids=oc["bias_ids"],
+            bias_vals=oc["bias_vals"],
+            lora_idx=oc["lora"],
+            bblock=self.decode_bblock)
+        self.counts = new_counts if want_pen else real_counts
+        self._pipe_carry = (tok, lens, self._carry_gen)
+        _metrics.pipeline.dispatches.inc()
+        _flight.record("pipeline_dispatch", None, horizon=1,
+                       batch=len(active), mixed=True)
+        return {"mixed": True, "out": out, "pout": pout, "horizon": 1,
+                "active": active, "gset": frozenset(), "gslots": [],
+                "want_lp": want_lp, "chunk_lp": chunk_lp,
+                "want_pen": want_pen, "chunk_n": len(chunk), "t0": t0}
 
     def _propose_drafts(self, active: List[int]):
         """Proposal source for the verify dispatch. With a draft model
@@ -1581,6 +1859,29 @@ class EnginePrograms:
                 and self._chunk is None
                 and not self.draining)
 
+    def _ragged_on(self) -> bool:
+        """May chunked prefill ride the ragged mixed-batch program?
+
+        Requires the paged pool (the ragged kernel gathers through per-row
+        page tables) and the pipeline itself (the whole point is keeping it
+        open). Gated off for spec decode (host mirrors must stay current),
+        LoRA (the packed [1, B+C] layout cannot apply per-row adapters),
+        multi-group meshes (the packed batch spans dp/sp shards), a
+        draining engine, and any active guided slot (its per-token host-FSM
+        mask cannot ride the packed row). Per-request guided gating happens
+        at the routing sites (``req.guided is None``)."""
+        if not (self.serving.ragged_attention > 0 and self.paged
+                and self.serving.decode_pipeline > 0
+                and not self.serving.spec_decode
+                and not self.lora_names
+                and not self.draining):
+            return False
+        if self.mesh is not None and (self.mesh.shape.get("dp", 1) > 1
+                                      or self.mesh.shape.get("sp", 1) > 1):
+            return False
+        return not any(r is not None and r.guided is not None
+                       for r in self.slot_req)
+
     def _carry_valid(self) -> bool:
         """True while the device-resident token/length carry of the
         in-flight dispatch still describes the batch — no slot was
@@ -1589,7 +1890,7 @@ class EnginePrograms:
         return (self._pipe_carry is not None
                 and self._pipe_carry[2] == self._carry_gen)
 
-    def _drain_decode_pipeline(self) -> None:
+    def _drain_decode_pipeline(self, reason: str = "drain") -> None:
         """Fetch + emit the in-flight decode dispatch, if any.
 
         Every transition that reads or rewrites slot state out of band of
@@ -1597,10 +1898,16 @@ class EnginePrograms:
         would mis-route the deferred emits), chunk start, spec decode,
         drain/failover. The device carry is dropped with it; the next
         dispatch re-uploads token/length from the now-fresh host mirrors.
+
+        ``reason`` feeds tpu_serve_pipeline_drains_total (prefill/chunk/
+        spec/guided/drain/fail) — the production-visible count of how often
+        the pipeline is forced shut, which the ragged mixed-batch path
+        (ISSUE 14) exists to drive to ~zero under mixed traffic.
         """
         rec = self._inflight
         if rec is None:
             return
+        _metrics.pipeline.drains.inc(reason=reason)
         self._inflight = None
         self._pipe_carry = None
         self.metrics.pipeline_depth.set(0.0)
@@ -1666,7 +1973,7 @@ class EnginePrograms:
             # preempt): its device carry no longer describes the batch, and
             # the host mirrors are stale until its tokens land — fetch
             # FIRST, then dispatch from the refreshed mirrors.
-            self._drain_decode_pipeline()
+            self._drain_decode_pipeline("prefill")
             prev = None
         active = self._active_slots()
         # Fused horizon unless a waiting prompt could actually prefill next
@@ -1706,7 +2013,7 @@ class EnginePrograms:
             active = self._active_slots()
             if prev is not None and not self._carry_valid():
                 # _ensure_pages preempted under the in-flight dispatch
-                self._drain_decode_pipeline()
+                self._drain_decode_pipeline("prefill")
                 prev = None
                 active = self._active_slots()
         if not active:
@@ -1792,6 +2099,11 @@ class EnginePrograms:
             # token and exhausts the cache window at half budget).
             self._pipe_carry = None
             if prev is not None:
+                _metrics.pipeline.drains.inc(reason=(
+                    "chunk" if self._chunk is not None
+                    else "guided" if gset
+                    else "spec" if self.serving.spec_decode
+                    else "drain"))
                 self._inflight = None
                 self.metrics.pipeline_depth.set(0.0)
                 self._decode_fetch(prev, tail=False)
@@ -1839,6 +2151,7 @@ class EnginePrograms:
         # un-penalized dispatches return a dummy counts array — keep ours
         self.counts = new_counts if want_pen else real_counts
         self._pipe_carry = (tok, lens, self._carry_gen)
+        _metrics.pipeline.dispatches.inc()
         # ring-only flight event (no per-request timeline work): a pure
         # deque append, safe on the async-dispatch half (tpulint R8)
         _flight.record("pipeline_dispatch", None, horizon=horizon,
@@ -1869,6 +2182,11 @@ class EnginePrograms:
             # an armed "pipeline_fetch_error" raises here, standing in for
             # a transfer/XLA failure surfacing at the deferred block point
             ch.on_pipeline_fetch(self)
+            if rec.get("mixed"):
+                # an armed "ragged_dispatch_error" targets only mixed
+                # dispatches — the in-flight record is discarded and the
+                # chunk walk's error path releases its slot exactly once
+                ch.on_mixed_fetch(self)
         out = rec["out"]
         lp_t = None
         if rec["want_lp"]:
@@ -1878,6 +2196,17 @@ class EnginePrograms:
             # network-attached chip thousands of times per dispatch)
             lp_t = tuple(np.asarray(a) for a in lp_t)
         out = np.asarray(out)  # [horizon, B] — blocks until device-complete
+        if rec.get("mixed"):
+            # chunk-row outputs ride the same record: the sampled token of
+            # the chunk's last position (only meaningful on the final
+            # chunk, where _advance_chunk_mixed activates with it)
+            pout = rec["pout"]
+            if rec["chunk_lp"]:
+                ptok_arr, plp = pout
+                rec["chunk_token"] = int(np.asarray(ptok_arr)[0])
+                rec["chunk_lp_t"] = tuple(np.asarray(a) for a in plp)
+            else:
+                rec["chunk_token"] = int(np.asarray(pout)[0])
         t_ready = time.monotonic()
         horizon = rec["horizon"]
         # Device-time attribution: the busy window opens at this dispatch's
@@ -1890,8 +2219,11 @@ class EnginePrograms:
         self._busy_watermark = t_ready
         self.metrics.device_busy_seconds.inc(dev_dt)
         self.metrics.decode_step_duration.observe(dev_dt / horizon)
-        _devmon.note("decode", dev_dt, batch=len(rec["active"]),
-                     tokens=horizon * len(rec["active"]),
+        _devmon.note("mixed_step" if rec.get("mixed") else "decode", dev_dt,
+                     batch=len(rec["active"]) + (1 if rec.get("mixed")
+                                                 else 0),
+                     tokens=horizon * len(rec["active"])
+                     + rec.get("chunk_n", 0),
                      ctx_rows=float(np.mean(self.lengths[
                          list(rec["active"])])) if rec["active"] else 0.0,
                      steps=horizon)

@@ -49,7 +49,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
 from aws_k8s_ansible_provisioner_tpu.serving import (capacity, devmon,
-                                                     flightrec, slo, tracing)
+                                                     flightrec, metrics, slo,
+                                                     tracing)
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import (
     Counter, Gauge, Registry)
 
@@ -754,7 +755,8 @@ class RouterHandler(BaseHTTPRequestHandler):
                     + flightrec.metrics.registry.render(om)
                     + slo.metrics.registry.render(om)
                     + devmon.metrics.registry.render(om)
-                    + capacity.metrics.registry.render(om))
+                    + capacity.metrics.registry.render(om)
+                    + metrics.pipeline.registry.render(om))
             if om:
                 text += "# EOF\n"
                 ctype = ("application/openmetrics-text; version=1.0.0; "

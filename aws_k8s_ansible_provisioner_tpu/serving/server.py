@@ -29,7 +29,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 from aws_k8s_ansible_provisioner_tpu.serving import (capacity, devmon,
-                                                     flightrec, slo, tracing)
+                                                     flightrec, metrics, slo,
+                                                     tracing)
 from aws_k8s_ansible_provisioner_tpu.serving.engine import (
     ContextLengthExceeded, EngineOverloaded)
 
@@ -344,6 +345,7 @@ class Handler(BaseHTTPRequestHandler):
                     + slo.metrics.registry.render(om)
                     + devmon.metrics.registry.render(om)
                     + capacity.metrics.registry.render(om)
+                    + metrics.pipeline.registry.render(om)
                     + render_engine_chips())
             if om:
                 text += "# EOF\n"
@@ -398,6 +400,13 @@ class Handler(BaseHTTPRequestHandler):
                 # pipeline would hide; pipelined steady state trends to 0.
                 "decode_pipeline": eng.serving.decode_pipeline,
                 "decode_bubble_pct": _bubble_pct(eng),
+                # Ragged mixed-batch attention (ISSUE 14): knob state plus
+                # the pipeline drain ledger — drains by reason and the
+                # drain rate (drains per dispatch). Mixed traffic on the
+                # ragged path should hold drain_rate ~0 where the legacy
+                # path pays one drain per admission.
+                "ragged_attention": eng.serving.ragged_attention,
+                "pipeline": metrics.pipeline.snapshot(),
                 "weights_dtype": eng.serving.weights_dtype,
                 "kv_dtype": eng.serving.kv_dtype,
                 "paged": bool(getattr(eng, "paged", False)),
@@ -1731,6 +1740,13 @@ def main(argv=None):
                         "hiding host emit/SSE time behind device compute "
                         "(seeded streams stay byte-identical). 0 restores "
                         "the synchronous dispatch-fetch-emit loop")
+    p.add_argument("--ragged-attention", type=int, default=1,
+                   help="ragged mixed-batch attention: chunked prefill "
+                        "packs into the SAME dispatch as the decode batch "
+                        "(one program, paged pool), so admissions stop "
+                        "draining the decode pipeline. 0 restores the "
+                        "legacy serialized chunk walk (sync escape hatch; "
+                        "seeded streams stay byte-identical)")
     p.add_argument("--chat-template", default="",
                    help="path to a Jinja chat template file")
     p.add_argument("--platform", default="",
@@ -1882,6 +1898,7 @@ def main(argv=None):
         kv_dtype=args.kv_dtype, weights_dtype=args.weights_dtype,
         decode_bblock=args.decode_bblock,
         decode_pipeline=args.decode_pipeline,
+        ragged_attention=args.ragged_attention,
         checkpoint_dir=args.checkpoint_dir, chat_template=args.chat_template,
         prefill_chunk=args.prefill_chunk,
         prefix_cache=not args.no_prefix_cache,

@@ -508,6 +508,11 @@ def measure() -> None:
         # on a network-attached chip the per-dispatch host bubble it pays is
         # the ~RTT-sized term the pipeline exists to hide.
         decode_pipeline=int(env("TPU_BENCH_PIPELINE", "1")),
+        # Ragged mixed-batch attention (r14): prefill chunks ride the decode
+        # pipeline inside one packed program instead of draining it at every
+        # admission edge. TPU_BENCH_RAGGED=0 is the sweep's sync-fallback
+        # axis (drain + separate chunk dispatch per admission).
+        ragged_attention=int(env("TPU_BENCH_RAGGED", "1")),
         # the tiny dry model runs f32 on CPU (parity with the test substrate)
         dtype="float32" if dry else "bfloat16",
     )
@@ -887,6 +892,126 @@ def pipeline() -> None:
         f.write("\n")
 
 
+def ragged() -> None:
+    """Ragged-vs-sync mixed-batch A/B under chunked-prefill-heavy load.
+
+    Two engines in one process (the second reuses the first's jitted
+    programs), identical seeded load, ragged_attention=0 then 1 — both with
+    the one-deep decode pipeline ON and chunked prefill forced, so the A/B
+    isolates exactly what ISSUE 14 changed: the legacy path drains the
+    pipeline at every prefill/chunk admission edge (one settle + one
+    standalone chunk dispatch per chunk), the ragged path packs each chunk
+    alongside the live decode batch into one mixed_step dispatch and never
+    drains. The timed window keeps a background decode batch generating
+    while a stream of long prompts chunk through — the workload whose
+    admission edges the old path paid for once per chunk. Reads the
+    engine's own metrics (tok/s over the window) plus the pipeline
+    drain/dispatch counters (serving/metrics.py PipelineMetrics) and writes
+    BENCH_ragged_r01.json. The ragged pass must match-or-beat sync tok/s
+    with ZERO admission-edge drains; on CPU the per-drain cost is
+    Python-settle-sized, on a network-attached TPU each drain additionally
+    pays ~one dispatch RTT (BENCH.json dispatch_rtt_ms ≈ 89.5 ms) before
+    the chunk can even dispatch.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+    import jax.numpy as jnp
+
+    from aws_k8s_ansible_provisioner_tpu.config import (ServingConfig,
+                                                        tiny_qwen3)
+    from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+    from aws_k8s_ansible_provisioner_tpu.serving import metrics as _smetrics
+    from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
+
+    batch = int(os.environ.get("TPU_BENCH_RAGGED_BATCH", "4"))
+    prompts = int(os.environ.get("TPU_BENCH_RAGGED_PROMPTS", "12"))
+    plen = int(os.environ.get("TPU_BENCH_RAGGED_PROMPT_LEN", "96"))
+    chunk = int(os.environ.get("TPU_BENCH_RAGGED_CHUNK", "16"))
+
+    def edge_drains() -> int:
+        by = _smetrics.pipeline.snapshot().get("drains_by_reason", {})
+        return int(by.get("prefill", 0)) + int(by.get("chunk", 0))
+
+    def run(ragged_attention: int) -> dict:
+        cfg = tiny_qwen3()
+        serving = ServingConfig(
+            model="tiny-qwen3", max_decode_slots=batch + 2,
+            max_cache_len=512, prefill_buckets=(32,), decode_horizon=4,
+            prefill_chunk=chunk, decode_pipeline=1,
+            ragged_attention=ragged_attention, dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+        engine = Engine(cfg, params, serving)
+        engine.warmup(scope="bench")
+        # Background decode batch: long-running streams that occupy `batch`
+        # slots for the whole window — the live rows every chunk admission
+        # either packs alongside (ragged) or drains out from under (sync).
+        for i in range(batch):
+            engine.submit(Request(
+                prompt_ids=[(11 * i + 5) % (cfg.vocab_size - 20) + 10] * 16,
+                max_tokens=360, ignore_eos=True, seed=100 + i))
+        while engine.pending:
+            engine.step()
+        for _ in range(5):
+            engine.step()           # warm the decode path / fill the pipe
+        # Chunked-prefill-heavy phase: a queue of long prompts churns
+        # through the two spare slots, each one chunking plen/chunk times.
+        jobs = [engine.submit(Request(
+            prompt_ids=[(7 * i + 3) % (cfg.vocab_size - 20) + 10] * plen,
+            max_tokens=4, seed=500 + i)) for i in range(prompts)]
+        m = engine.metrics
+        toks0 = m.generated_tokens.total()
+        drains0, disp0 = edge_drains(), \
+            _smetrics.pipeline.snapshot()["dispatches_total"]
+        t0 = time.monotonic()
+        while not all(r.finish_reason for r in jobs):
+            engine.step()
+        if engine._inflight is not None:
+            # count the trailing in-flight dispatch inside the timed window
+            engine._drain_decode_pipeline()
+        dt = time.monotonic() - t0
+        assert all(r.finish_reason == "length" for r in jobs), \
+            [r.finish_reason for r in jobs]
+        return {
+            "toks_per_s": (m.generated_tokens.total() - toks0) / dt,
+            "edge_drains": edge_drains() - drains0,
+            "dispatches": _smetrics.pipeline.snapshot()["dispatches_total"]
+            - disp0,
+            "wall_s": dt,
+        }
+
+    sync, rag = run(0), run(1)
+    out = {
+        "bench": "ragged", "rev": "r01",
+        "model": "tiny-qwen3", "platform": jax.devices()[0].platform,
+        "batch": batch, "prompts": prompts, "prompt_len": plen,
+        "prefill_chunk": chunk,
+        "sync_toks_per_s": round(sync["toks_per_s"], 1),
+        "ragged_toks_per_s": round(rag["toks_per_s"], 1),
+        "speedup": round(rag["toks_per_s"] / max(1e-9, sync["toks_per_s"]),
+                         3),
+        # the structural claim: the old path drained once per admission
+        # edge, the ragged path holds the pipe open through every chunk
+        "sync_edge_drains": sync["edge_drains"],
+        "ragged_edge_drains": rag["edge_drains"],
+        "sync_dispatches": sync["dispatches"],
+        "ragged_dispatches": rag["dispatches"],
+        "sync_wall_s": round(sync["wall_s"], 3),
+        "ragged_wall_s": round(rag["wall_s"], 3),
+    }
+    print(json.dumps(out), flush=True)
+    if not (rag["toks_per_s"] >= sync["toks_per_s"]
+            and rag["edge_drains"] == 0 and sync["edge_drains"] > 0):
+        raise SystemExit(f"ragged bench: mixed path did not beat the sync "
+                         f"fallback ({out})")
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_ragged_r01.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+
+
 if __name__ == "__main__":
     if "--measure" in sys.argv:
         measure()
@@ -896,6 +1021,8 @@ if __name__ == "__main__":
         coldstart()
     elif "--pipeline" in sys.argv:
         pipeline()
+    elif "--ragged" in sys.argv:
+        ragged()
     elif "--dry" in sys.argv:
         # Seconds-class CPU pass over the tiny model, in-process: proves the
         # whole field plumbing (bblock, weights_dtype, dma_steps_per_substep,

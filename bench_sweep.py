@@ -47,6 +47,11 @@ DEFAULT_GRID = {
     #    the 0-axis measures that gap for real (bench.py --pipeline is the
     #    chip-free CPU proof of the same machinery).
     "TPU_BENCH_PIPELINE": ["1", "0"],
+    # 4) ragged mixed-batch attention A/B (r14): the 0-axis measures the
+    #    sync fallback that drains the pipeline (and pays a dispatch RTT)
+    #    at every prefill/chunk admission edge; bench.py --ragged is the
+    #    chip-free chunked-prefill-heavy CPU proof of the same machinery.
+    "TPU_BENCH_RAGGED": ["1", "0"],
 }
 
 # --ttft: the prefill-lever grid (VERDICT r5 weak #3 — the 2,408 ms cold-

@@ -14,10 +14,18 @@ behind device execution. These tests pin the correctness contract:
   and the engine keeps serving (chaos.py docstring contract);
 - the new metrics (tpu_serve_decode_bubble_seconds_total,
   tpu_serve_pipeline_depth) register, move, and render on /metrics, and
-  /healthz reports the knob plus the bubble percentage.
+  /healthz reports the knob plus the bubble percentage;
+- ragged mixed-batch attention (ISSUE 14, ``ragged_smoke`` marker):
+  interleaved chunked-prefill admissions hold the pipeline OPEN (zero
+  admission-edge drains on tpu_serve_pipeline_drains_total where the legacy
+  path drains once per admission), seeded streams are byte-identical ragged
+  vs legacy across sampled/logprobs/penalties, and the injected
+  ``ragged_dispatch_error`` fault drops the mixed dispatch without killing
+  the engine.
 
 `make pipeline-smoke` runs this file LockSan-instrumented (TPU_LOCKSAN=1);
-tier-1 runs it bare via the ``pipeline_smoke`` marker.
+`make ragged-smoke` runs the ragged subset; tier-1 runs it bare via the
+``pipeline_smoke`` marker.
 """
 
 import json
@@ -32,6 +40,7 @@ import pytest
 from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
 from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
+from aws_k8s_ansible_provisioner_tpu.serving import metrics as _metrics
 from aws_k8s_ansible_provisioner_tpu.serving.engine import (
     Engine, EngineOverloaded, Request)
 from aws_k8s_ansible_provisioner_tpu.serving.guided import grammar_for
@@ -344,6 +353,137 @@ def test_pipeline_fetch_error_discards_inflight_and_recovers(model):
         t.join(timeout=10)
 
 
+# -- ragged mixed-batch attention (ISSUE 14) ---------------------------------
+
+
+def _edge_drains() -> int:
+    """Admission-edge drains: the prefill + chunk reasons of the process-wide
+    tpu_serve_pipeline_drains_total ledger — exactly the drains the ragged
+    mixed path exists to eliminate (end-of-run idle settles count under
+    'drain' and are expected either way)."""
+    by = _metrics.pipeline.snapshot()["drains_by_reason"]
+    return by.get("prefill", 0) + by.get("chunk", 0)
+
+
+_LONG_A = [(i % 150) + 4 for i in range(100)]
+_LONG_B = [(i % 90) + 6 for i in range(80)]
+
+
+def _ragged_engine(model, ragged: int, **over):
+    # horizon pinned small so the background stream is still decoding (an
+    # in-flight dispatch live) when the chunked admissions arrive — the
+    # whole point of the mixed-traffic cases
+    return _engine(model, decode_pipeline=1, ragged_attention=ragged,
+                   prefill_chunk=32, max_cache_len=256, decode_horizon=4,
+                   **over)
+
+
+@pytest.mark.ragged_smoke
+def test_mixed_traffic_pipeline_stays_open_and_byte_identical(model):
+    """The tentpole contract: interleaved chunked-prefill admissions ride
+    the SAME dispatch as the decode batch, so the pipeline never drains on
+    an admission edge (the legacy path drains once per admission) — and
+    every seeded stream is byte-identical to the legacy engine's."""
+
+    def run(ragged):
+        eng = _ragged_engine(model, ragged)
+        first = eng.submit(Request(prompt_ids=[5, 9, 2], max_tokens=100,
+                                   temperature=0.9, seed=42,
+                                   ignore_eos=True))
+        # get the first stream decoding (pipelined: an in-flight dispatch)
+        for _ in range(6):
+            eng.step()
+        # the background stream must still be mid-decode with a dispatch in
+        # flight, or the admission edges below exercise nothing
+        assert eng._inflight is not None
+        before = _edge_drains()
+        late_a = eng.submit(Request(prompt_ids=list(_LONG_A), max_tokens=8,
+                                    temperature=0.9, seed=7,
+                                    ignore_eos=True))
+        for _ in range(10):
+            eng.step()
+        late_b = eng.submit(Request(prompt_ids=list(_LONG_B), max_tokens=8,
+                                    temperature=0.8, seed=13,
+                                    ignore_eos=True))
+        _drain(eng)
+        return eng, (first, late_a, late_b), _edge_drains() - before
+
+    eng1, ragged_streams, ragged_edge = run(1)
+    eng0, legacy_streams, legacy_edge = run(0)
+    for r, s in zip(ragged_streams, legacy_streams):
+        assert _stream_bytes(r) == _stream_bytes(s), \
+            "ragged mixed stream must be byte-identical to the legacy path"
+    assert all(r.finish_reason == "length" for r in ragged_streams)
+    # zero drains across interleaved admissions on the ragged path; the
+    # legacy path pays at least one per chunked admission
+    assert ragged_edge == 0, \
+        f"ragged path drained the pipeline {ragged_edge}x on admission edges"
+    assert legacy_edge > 0, \
+        "legacy path should drain on chunked admissions (test is vacuous)"
+    _assert_released(eng1)
+    _assert_released(eng0)
+
+
+@pytest.mark.ragged_smoke
+def test_ragged_vs_legacy_parity_sampled_logprobs_penalties(model):
+    """Feature parity through the mixed program: sampled, logprobs, and
+    penalties requests produce byte-identical streams ragged vs legacy."""
+    specs = [
+        dict(prompt_ids=list(_LONG_A), max_tokens=10, temperature=0.8,
+             seed=3, ignore_eos=True, logprobs=3),
+        dict(prompt_ids=[4, 8, 15], max_tokens=16, temperature=0.7, seed=5,
+             ignore_eos=True, presence_penalty=0.5, frequency_penalty=0.3,
+             repetition_penalty=1.15),
+        dict(prompt_ids=list(_LONG_B), max_tokens=10, temperature=0.9,
+             seed=8, ignore_eos=True, repetition_penalty=1.2),
+    ]
+    ragged = _run_set(_ragged_engine(model, 1), [dict(s) for s in specs])
+    legacy = _run_set(_ragged_engine(model, 0), [dict(s) for s in specs])
+    for r, s in zip(ragged, legacy):
+        assert _stream_bytes(r) == _stream_bytes(s)
+    assert all(r.finish_reason == "length" for r in ragged)
+
+
+@pytest.mark.ragged_smoke
+def test_ragged_dispatch_error_drops_dispatch_keeps_serving(model):
+    """chaos.py contract for ``ragged_dispatch_error``: the in-flight mixed
+    dispatch is discarded un-emitted, the half-prefilled slot's pages
+    release exactly once, affected requests fail with 'error', and the
+    engine keeps serving the next request (drop-not-fail)."""
+    _chaos.get().inject("ragged_dispatch_error", after=1, times=1)
+    eng = _ragged_engine(model, 1)
+    stop = threading.Event()
+    t = threading.Thread(target=eng.run_forever, args=(stop,), daemon=True)
+    t.start()
+    try:
+        decoding = eng.generate([7] * 4, max_tokens=64, temperature=1.0,
+                                ignore_eos=True)
+        deadline = time.monotonic() + 20
+        while len(decoding.generated) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        chunked = eng.generate(list(_LONG_A), max_tokens=8, temperature=0.9,
+                               ignore_eos=True)
+        # the live decode stream had tokens before the fault; the chunk-walk
+        # request dies un-emitted (wait returns its empty generated list)
+        assert decoding.wait(timeout=30.0)
+        chunked.wait(timeout=30.0)
+        assert chunked.finish_reason == "error", chunked.finish_reason
+        assert chunked.generated == [], "discarded dispatch must not emit"
+        # the in-flight mixed dispatch was discarded, not emitted or leaked
+        assert eng._inflight is None
+        assert eng.metrics.pipeline_depth.value() == 0.0
+        # recovery: the same engine completes a fresh request normally
+        ok = eng.generate([2, 4, 6], max_tokens=6, temperature=0.0,
+                          ignore_eos=True)
+        assert ok.wait(timeout=30.0)
+        assert ok.finish_reason == "length"
+        assert len(ok.generated) == 6
+        _assert_released(eng)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
 # -- metrics and observability ----------------------------------------------
 
 
@@ -409,12 +549,18 @@ def test_http_healthz_and_metrics_expose_pipeline(model):
             health = json.loads(r.read())
         assert health["decode_pipeline"] == 1
         assert "decode_bubble_pct" in health
+        # ragged mixed-batch knob + the drain ledger (ISSUE 14)
+        assert health["ragged_attention"] == 1
+        assert "drain_rate" in health["pipeline"]
+        assert "drains_by_reason" in health["pipeline"]
 
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
             text = r.read().decode()
         assert "tpu_serve_decode_bubble_seconds_total" in text
         assert "tpu_serve_pipeline_depth" in text
+        assert "tpu_serve_pipeline_drains_total" in text
+        assert "tpu_serve_pipeline_dispatches_total" in text
     finally:
         stop.set()
         time.sleep(0.1)

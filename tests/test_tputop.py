@@ -176,6 +176,37 @@ def test_render_capacity_panel_golden_frame():
     assert " :=+#" in row_a
 
 
+def test_render_pipeline_drain_column():
+    """The ``drain`` column renders the /healthz pipeline block's drain
+    rate (drains per dispatch — ~0 on the ragged mixed path); a replica
+    predating the block degrades to '-'."""
+    ragged = _healthy()
+    ragged["pipeline"] = {"drains_total": 1, "dispatches_total": 400,
+                          "drain_rate": 0.0025,
+                          "drains_by_reason": {"drain": 1}}
+    legacy = _healthy()
+    legacy["pipeline"] = {"drains_total": 50, "dispatches_total": 100,
+                          "drain_rate": 0.5,
+                          "drains_by_reason": {"prefill": 50}}
+    fleet = {
+        "backends": ["a:1", "b:2", "c:3"], "cooling_down": [], "draining": [],
+        "replicas": {
+            "a:1": {"cooling": False, "draining": False, "health": ragged},
+            "b:2": {"cooling": False, "draining": False, "health": legacy},
+            "c:3": {"cooling": False, "draining": False,
+                    "health": _healthy()},   # pre-ragged build
+        },
+    }
+    lines = tputop.render(fleet).splitlines()
+    drain_i = tputop.COLUMNS.index("drain")
+    row_a = next(ln for ln in lines if ln.startswith("a:1"))
+    assert row_a.split()[drain_i] == "0.00"
+    row_b = next(ln for ln in lines if ln.startswith("b:2"))
+    assert row_b.split()[drain_i] == "0.50"
+    row_c = next(ln for ln in lines if ln.startswith("c:3"))
+    assert row_c.split()[drain_i] == "-"
+
+
 def test_render_mixed_version_fleet_na_capacity_cells():
     """A replica whose /healthz predates serving/capacity.py (rollout in
     progress) must render '-' capacity cells — not a KeyError — while a

@@ -30,8 +30,8 @@ import sys
 # key basename -> desired direction (throughputs, ratios-of-goodness)
 HIGHER_BETTER = frozenset({
     "toks_per_s", "agg_toks_per_s", "sync_toks_per_s", "pipe_toks_per_s",
-    "ceiling_toks_per_s", "pct_of_ceiling", "speedup", "warm_speedup",
-    "aot_speedup", "prefix_hit_rate", "bubble_reduction_pct",
+    "ragged_toks_per_s", "ceiling_toks_per_s", "pct_of_ceiling", "speedup",
+    "warm_speedup", "aot_speedup", "prefix_hit_rate", "bubble_reduction_pct",
     "offered_rps", "completed_rps", "service_capacity_rps",
 })
 # latencies, bubbles, ready times
@@ -40,7 +40,7 @@ LOWER_BETTER = frozenset({
     "sync_bubble_ms_per_step", "pipe_bubble_ms_per_step",
     "bubble_ms_per_step", "cold_ready_s", "warm_ready_s", "aot_ready_s",
     "dispatch_rtt_ms", "failover_first_success_ms", "latency_p50_ms",
-    "latency_p95_ms", "shed_rate",
+    "latency_p95_ms", "shed_rate", "ragged_edge_drains",
 })
 
 

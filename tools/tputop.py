@@ -26,7 +26,7 @@ import time
 import urllib.error
 import urllib.request
 
-COLUMNS = ("replica", "st", "tok/s", "act", "que", "pages", "bub%",
+COLUMNS = ("replica", "st", "tok/s", "act", "que", "pages", "bub%", "drain",
            "hbm", "mfu", "duty%", "cap", "sat", "burn5m", "last anomaly")
 
 # burn column position (header logic keys off it; keep derived so the
@@ -149,6 +149,8 @@ def _row(addr: str, ent: dict, hist=None) -> list:
     pages_u = h.get("kv_pages_in_use") or 0
     pages = f"{pages_u}/{pages_t}" if pages_t else "-"
     bub = h.get("decode_bubble_pct")
+    pipe = h.get("pipeline")
+    drain = pipe.get("drain_rate") if isinstance(pipe, dict) else None
     dev = h.get("device") or {}
     mfu = dev.get("mfu")
     duty = dev.get("duty_cycle")
@@ -168,6 +170,10 @@ def _row(addr: str, ent: dict, hist=None) -> list:
             "-" if que is None else str(que),
             pages,
             "-" if bub is None else f"{bub:.1f}",
+            # pipeline drain rate (drains per dispatch; serving/metrics.py
+            # PipelineMetrics): ~0 on the ragged mixed path, one per
+            # admission on the legacy path. Pre-ragged replicas render "-".
+            "-" if drain is None else f"{drain:.2f}",
             _hbm_bar(dev),
             "-" if mfu is None else f"{mfu:.2f}",
             "-" if duty is None else f"{100.0 * duty:.0f}",
