@@ -7,7 +7,7 @@ PY ?= python
 .PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests \
 	overload-smoke resume-smoke reconcile-smoke trace-smoke lint \
 	locksan-smoke aot-smoke pipeline-smoke ragged-smoke flight-smoke \
-	devmon-smoke capacity-smoke bench-diff bench-ragged
+	devmon-smoke capacity-smoke bench-diff bench-ragged autoscale-smoke
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -179,6 +179,12 @@ devmon-smoke:
 # the same tests (marker capacity_smoke).
 capacity-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m capacity_smoke \
+		-p no:cacheprovider
+
+# Fleet actuation (serving/autoscaler.py): ramp e2e through real servers,
+# scale-to-zero cold start, flap suppression, launch-failure backoff.
+autoscale-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m autoscale_smoke \
 		-p no:cacheprovider
 
 # Artifact regression differ (tools/benchdiff.py): compare a fresh bench

@@ -788,6 +788,32 @@ class ServingConfig:
     # the EWMA + linear-trend saturation forecast fits over.
     capacity_window_s: float = 60.0
     capacity_trend_window_s: float = 300.0
+    # ---- Fleet actuation (serving/autoscaler.py — runs in the ROUTER
+    # process) ----
+    # The reconcile controller that consumes the capacity signal: off by
+    # default (the signal plane is always on; actuation is opt-in).
+    autoscale_enabled: bool = False
+    # Replica floor/ceiling. Floor 0 enables scale-to-zero: an idle fleet
+    # parks behind the router and the first request cold-starts it
+    # (AOT-backed, hidden by the prewarmed standby pool).
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 8
+    # Prewarmed standbys kept ready OUT of rotation; -1 derives the size
+    # from the AOT manifest ready-time (autoscale_ready_s).
+    autoscale_standby: int = -1
+    # Reconcile tick; hysteresis persistence a target change must survive
+    # before committing; the direction-reversal cooldown (flap
+    # suppression); and the idle window before scale-to-zero parks.
+    autoscale_interval_s: float = 1.0
+    autoscale_stable_s: float = 5.0
+    autoscale_cooldown_s: float = 30.0
+    autoscale_idle_timeout_s: float = 120.0
+    # Launch admission: a spawned replica must answer /readyz within this
+    # (default ~10x the 5.5 s AOT ready-time — a cold compile is a bug).
+    autoscale_ready_timeout_s: float = 60.0
+    # The measured AOT ready-time (BENCH_coldstart_r01) the standby size
+    # and cold-start budget derive from.
+    autoscale_ready_s: float = 5.5
     # Seed for the engine's DERIVED sampling seeds (requests without an
     # OpenAI ``seed``). None = entropy from os.urandom at engine start, so
     # restarts and replicas draw independently (the vLLM/OpenAI
@@ -952,6 +978,20 @@ def ansible_vars(cfg: FrameworkConfig | None = None,
     # forecast horizon matches the deployment's measured AOT ready-time.
     d["serving_capacity_headroom_s"] = cfg.serving.capacity_headroom_s
     d["serving_capacity_window_s"] = cfg.serving.capacity_window_s
+    # Fleet actuation (serving/autoscaler.py): the manifest threads these
+    # to the router's --autoscale-* flags. In-cluster the controller
+    # drains/undrains and adopts what the Deployment runs; the launch
+    # command template is deliberately NOT set by default (kubernetes owns
+    # pod creation — a CommandLauncher only makes sense on a bare host).
+    d["serving_autoscale_enabled"] = cfg.serving.autoscale_enabled
+    d["serving_autoscale_min_replicas"] = cfg.serving.autoscale_min_replicas
+    d["serving_autoscale_max_replicas"] = cfg.serving.autoscale_max_replicas
+    d["serving_autoscale_standby"] = cfg.serving.autoscale_standby
+    d["serving_autoscale_interval_s"] = cfg.serving.autoscale_interval_s
+    d["serving_autoscale_stable_s"] = cfg.serving.autoscale_stable_s
+    d["serving_autoscale_cooldown_s"] = cfg.serving.autoscale_cooldown_s
+    d["serving_autoscale_idle_timeout_s"] = \
+        cfg.serving.autoscale_idle_timeout_s
     # --set overrides (rehearsals pin model/ports); unknown keys pass
     # through — the playbooks treat group_vars as an open namespace
     d.update(overrides or {})

@@ -167,6 +167,14 @@ class CapacityEstimator:
         # (t, tokens_requested, shed) — one entry per submit() outcome
         self._submits: Deque[Tuple[float, float, int]] = deque(
             maxlen=self.MAX_SAMPLES)
+        # last submit() of any kind — the autoscaler's scale-to-zero idle
+        # signal (None = never: idle since birth)
+        self._last_submit_t: Optional[float] = None
+        # saturation-calibrated ceiling (tok/s): EWMA of the admitted
+        # token rate measured while admission was SHEDDING — ground truth
+        # that overrides an optimistic analytical ceiling (0 = never
+        # calibrated; see snapshot())
+        self._observed_ceiling_tps: float = 0.0
         # engine wiring (installed by Engine._install_capacity)
         self._queue_depth_fn: Optional[Callable[[], int]] = None
         self._measured_tps_fn: Optional[Callable[[], float]] = None
@@ -196,6 +204,7 @@ class CapacityEstimator:
             return
         now = self.clock()
         with self._lock:
+            self._last_submit_t = now
             self._submits.append((now, max(0.0, float(tokens)),
                                   1 if shed else 0))
             trim_window(self._submits, now, self.trend_window_s)
@@ -327,6 +336,23 @@ class CapacityEstimator:
         off = self.offered(now)
         ceil_d = self.ceiling(now)
         ceiling = ceil_d["ceiling_tps"]
+        ceiling_source = ceil_d["source"]
+        # Saturation calibration: while admission is SHEDDING, the replica
+        # is by definition serving at its real limit, so the admitted token
+        # rate in that window is a measured ceiling — ground truth that
+        # beats the roofline blend (wildly optimistic off-TPU, where a
+        # ceiling too generous would report ~0 utilization while clients
+        # eat 429s, pinning the fleet recommendation at its current size).
+        admitted_tps = off["admitted_per_s"] * off["avg_tokens_per_request"]
+        with self._lock:
+            if off["shed_per_s"] > 0.0 and admitted_tps > 0.0:
+                prior = self._observed_ceiling_tps
+                self._observed_ceiling_tps = admitted_tps if prior <= 0.0 \
+                    else EWMA_ALPHA * admitted_tps + (1 - EWMA_ALPHA) * prior
+            observed = self._observed_ceiling_tps
+        if 0.0 < observed < ceiling:
+            ceiling = observed
+            ceiling_source = "observed"
         offered_tps = off["tokens_per_s"]
         utilization = (offered_tps / ceiling) if ceiling > 0.0 else 0.0
 
@@ -363,15 +389,24 @@ class CapacityEstimator:
             recommended = max(1, math.ceil(projected / ceiling - 1e-9))
         else:
             recommended = 1
+        with self._lock:
+            last_submit = self._last_submit_t
+        if last_submit is not None:
+            last_submit_age = max(0.0, now - last_submit)
+        else:
+            # never submitted: idle for the estimator's whole life
+            last_submit_age = max(0.0, now - self._t0)
         return {
             "enabled": self.enabled,
             "window_s": self.window_s,
             "trend_window_s": self.trend_window_s,
             "headroom_s": self.headroom_s,
+            "last_submit_age_s": round(last_submit_age, 3),
+            "idle": offered_tps <= 0.0,
             "offered": off,
             "offered_tps": offered_tps,
             "ceiling_tps": ceiling,
-            "ceiling_source": ceil_d["source"],
+            "ceiling_source": ceiling_source,
             "measured_tps": ceil_d["measured_tps"],
             "roofline_tps": ceil_d["roofline_tps"],
             "duty_factor": ceil_d["duty_factor"],

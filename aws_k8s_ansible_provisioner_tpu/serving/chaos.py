@@ -62,6 +62,23 @@ fault                       defined degradation behavior
                             (``tpu_capacity_export_drops_total``) and
                             requests succeed unchanged — the estimator can
                             never block a request
+``autoscale_launch_error``  a replica launch fails. ``mode=transient``
+                            (default) raises an error matching
+                            miniansible's TRANSIENT_PATTERNS — the
+                            autoscaler must retry on its deterministic
+                            capped backoff schedule; ``mode=fatal`` raises
+                            an unclassifiable error — the autoscaler must
+                            journal the give-up and keep reconciling.
+                            Either way the failure is counted
+                            (``tpu_autoscale_launch_failures{class}``) and
+                            never wedges the controller
+``autoscale_drain_stuck``   a draining replica's inflight count never
+                            reaches zero (a wedged stream): the autoscaler
+                            must flag it stuck after ``drain_stuck_s``
+                            (``tpu_autoscale_stuck_replicas``, journal
+                            entry) and force-reap it at
+                            ``drain_escalate_s`` — escalation through the
+                            reconcile path, never a wedged controller
 ``deadline``                (engine-native, no injection needed) request
                             past its deadline is cancelled, slot/pages
                             released, client gets 408 deadline_exceeded
@@ -97,7 +114,8 @@ FAULTS = ("connect_refused", "stalled_decode", "page_exhaustion",
           "slow_client", "mid_stream_disconnect", "kill_stream",
           "stream_read_error", "span_export", "pipeline_fetch_error",
           "ragged_dispatch_error", "flight_dump_error",
-          "capacity_export_error")
+          "capacity_export_error", "autoscale_launch_error",
+          "autoscale_drain_stuck")
 
 
 class InjectedFault(RuntimeError):
@@ -385,6 +403,43 @@ class ChaosController:
         if p is None:
             return
         raise InjectedFault("chaos: injected capacity export failure")
+
+    def on_autoscale_launch(self) -> None:
+        """autoscaler._do_launch entry (the reconcile tick — never a
+        request thread): an armed ``autoscale_launch_error`` raises in
+        place of the launcher call. ``mode=transient`` (default) phrases
+        the error so ``miniansible.classify_failure`` tags it transient —
+        the controller must schedule a deterministic-backoff retry;
+        ``mode=fatal`` phrases it unclassifiably — the controller must
+        journal the give-up. tests/test_autoscaler.py asserts both arms
+        of that drop-not-fail contract."""
+        p = self.fire("autoscale_launch_error")
+        if p is None:
+            return
+        if str(p.get("mode", "transient")) == "fatal":
+            raise InjectedFault(
+                "chaos: replica manifest rejected by admission webhook "
+                "(invalid spec)")
+        raise InjectedFault(
+            "chaos: cloud API temporarily unavailable provisioning "
+            "replica VM")
+
+    def on_autoscale_drain(self, addr: str) -> bool:
+        """autoscaler._progress_drains poll (the reconcile tick): an
+        armed ``autoscale_drain_stuck`` makes ``addr``'s inflight read as
+        permanently nonzero — a wedged stream that never finishes. Each
+        poll consumes one trigger, so ``times`` is the number of ticks
+        the drain stays wedged: armed long enough it drives the
+        stuck-flag (``drain_stuck_s``) and force-reap
+        (``drain_escalate_s``) escalation path. ``addr_prefix`` restricts
+        it to matching replicas."""
+        p = self.fire("autoscale_drain_stuck")
+        if p is None:
+            return False
+        prefix = str(p.get("addr_prefix", ""))
+        if prefix and not addr.startswith(prefix):
+            return False
+        return True
 
 
 _controller: Optional[ChaosController] = None

@@ -48,9 +48,9 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
-from aws_k8s_ansible_provisioner_tpu.serving import (capacity, devmon,
-                                                     flightrec, metrics, slo,
-                                                     tracing)
+from aws_k8s_ansible_provisioner_tpu.serving import (autoscaler, capacity,
+                                                     devmon, flightrec,
+                                                     metrics, slo, tracing)
 from aws_k8s_ansible_provisioner_tpu.serving.metrics import (
     Counter, Gauge, Registry)
 
@@ -170,6 +170,9 @@ class BackendPool:
         self.cooldown_s = cooldown_s
         self.load_slack = load_slack
         self._lock = threading.Lock()
+        # autoscaler-managed replicas: layered on top of whatever DNS/the
+        # static list resolves, surviving refreshes until remove_backend
+        self._dynamic: list[str] = []
         self._addrs: list[str] = list(self._static)
         self._rr = itertools.count()
         self._dead: dict[str, float] = {}
@@ -189,13 +192,20 @@ class BackendPool:
 
     def _resolve(self) -> list[str]:
         if self._static:
-            return list(self._static)
-        try:
-            infos = socket.getaddrinfo(self.host, self.port, socket.AF_INET,
-                                       socket.SOCK_STREAM)
-            return sorted({f"{i[4][0]}:{self.port}" for i in infos})
-        except socket.gaierror:
-            return []
+            base = list(self._static)
+        elif self.host is None:
+            # a fully-drained static pool (scale-to-zero): nothing to
+            # resolve — the autoscaler's dynamic layer is the whole fleet
+            base = []
+        else:
+            try:
+                infos = socket.getaddrinfo(self.host, self.port,
+                                           socket.AF_INET,
+                                           socket.SOCK_STREAM)
+                base = sorted({f"{i[4][0]}:{self.port}" for i in infos})
+            except socket.gaierror:
+                base = []
+        return base + [a for a in self._dynamic if a not in base]
 
     def addrs(self) -> list[str]:
         """Current replica set (refreshing if stale) — the poller's target
@@ -312,6 +322,40 @@ class BackendPool:
             self._dead[addr] = time.monotonic()
             self._load.pop(addr, None)
 
+    def add_backend(self, addr: str) -> bool:
+        """Admit an autoscaler-launched replica into rotation NOW. The
+        address joins the dynamic layer (surviving DNS refreshes) and any
+        stale dead/draining record from a previous life at the same
+        address is cleared. Returns whether it was new."""
+        with self._lock:
+            fresh = addr not in self._dynamic
+            if fresh:
+                self._dynamic.append(addr)
+            if addr not in self._addrs:
+                self._addrs.append(addr)
+            self._dead.pop(addr, None)
+            self._draining.pop(addr, None)
+            return fresh
+
+    def remove_backend(self, addr: str) -> bool:
+        """Take a replica out of the pool permanently (autoscaler
+        scale-down: the drain handles in-flight work; this stops NEW
+        requests landing on it). Removes it from the static list too, so
+        a drained initial backend stays gone. Returns whether it was
+        present."""
+        with self._lock:
+            present = addr in self._addrs
+            if present:
+                self._addrs.remove(addr)
+            if addr in self._dynamic:
+                self._dynamic.remove(addr)
+            if addr in self._static:
+                self._static.remove(addr)
+            self._load.pop(addr, None)
+            self._affinity = collections.OrderedDict(
+                (k, a) for k, a in self._affinity.items() if a != addr)
+            return present
+
     def note_draining(self, addr: str) -> bool:
         """A replica reported ``draining``: remove it from rotation WITHOUT
         dead-marking (no cooldown to serve out — it re-enters within one
@@ -407,7 +451,8 @@ def _fleet_capacity(fleet: dict) -> dict:
     more replica of the current mix would add)."""
     replicas = {}
     offered = ceiling = projected = 0.0
-    reporting = saturated = 0
+    admitted_rps = shed_rps = 0.0
+    reporting = saturated = idle = 0
     for addr, ent in fleet.items():
         cap = (ent.get("health") or {}).get("capacity")
         if not isinstance(cap, dict):
@@ -424,7 +469,11 @@ def _fleet_capacity(fleet: dict) -> dict:
             "seconds_to_saturation": cap.get("seconds_to_saturation"),
             "saturated": bool(cap.get("saturated", False)),
             "recommended_replicas": cap.get("recommended_replicas", 1),
+            "idle": bool(cap.get("idle", False)),
+            "last_submit_age_s": cap.get("last_submit_age_s"),
         }
+        if row["idle"]:
+            idle += 1
         if "health_age_s" in ent:
             row["age_s"] = ent["health_age_s"]
         replicas[addr] = row
@@ -432,21 +481,49 @@ def _fleet_capacity(fleet: dict) -> dict:
         ceiling += float(cap.get("ceiling_tps") or 0.0)
         projected += float(cap.get("projected_offered_tps")
                            or cap.get("offered_tps") or 0.0)
+        off_block = cap.get("offered")
+        if isinstance(off_block, dict):
+            admitted_rps += float(off_block.get("admitted_per_s") or 0.0)
+            shed_rps += float(off_block.get("shed_per_s") or 0.0)
         if cap.get("saturated"):
             saturated += 1
     mean_ceiling = (ceiling / reporting) if reporting else 0.0
     if mean_ceiling > 0:
-        recommended = max(reporting,
-                          math.ceil(projected / mean_ceiling - 1e-9))
+        # Demand-derived, deliberately NOT floored at the current fleet
+        # size: a recommendation that can never go below reporting_replicas
+        # would make scale-down impossible for the actuation loop. The
+        # autoscaler's hysteresis + cooldown absorb a transiently low
+        # reading; a fleet with no measured ceiling keeps the floor.
+        recommended = max(1, math.ceil(projected / mean_ceiling - 1e-9))
     else:
         recommended = max(1, reporting)
+    if shed_rps > 0.0 and reporting > 0:
+        # Shed-aware floor: a fleet turning requests away at admission is
+        # saturated by OBSERVATION, whatever the ceiling arithmetic claims
+        # (the roofline blend is wildly optimistic off-TPU, and a ceiling
+        # too generous would otherwise pin the recommendation at the
+        # current size while clients eat 429s). Demand in requests/s is
+        # admitted + shed; what the current fleet actually services is the
+        # admitted rate, so size by their ratio.
+        if admitted_rps > 0.0:
+            factor = (admitted_rps + shed_rps) / admitted_rps
+            recommended = max(recommended,
+                              math.ceil(reporting * factor - 1e-9))
+        else:
+            recommended = max(recommended, reporting + 1)
     return {
         "replicas": replicas,
         "fleet": {
             "reporting_replicas": reporting,
             "missing_replicas": len(fleet) - reporting,
             "saturated_replicas": saturated,
+            "idle_replicas": idle,
+            # the autoscaler's scale-to-zero gate: every measured replica
+            # reports zero offered load over its window
+            "idle": reporting > 0 and idle == reporting,
             "offered_tps": round(offered, 6),
+            "admitted_rps": round(admitted_rps, 6),
+            "shed_rps": round(shed_rps, 6),
             "ceiling_tps": round(ceiling, 6),
             "utilization": round(offered / ceiling, 6) if ceiling > 0
             else 0.0,
@@ -748,6 +825,7 @@ class RouterHandler(BaseHTTPRequestHandler):
             slo.get().export()
             devmon.get().export()
             capacity.get().export()
+            autoscaler.get().export()
             om = "application/openmetrics-text" in \
                 (self.headers.get("Accept") or "")
             text = (self.metrics.registry.render(om)
@@ -756,6 +834,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                     + slo.metrics.registry.render(om)
                     + devmon.metrics.registry.render(om)
                     + capacity.metrics.registry.render(om)
+                    + autoscaler.metrics.registry.render(om)
                     + metrics.pipeline.registry.render(om))
             if om:
                 text += "# EOF\n"
@@ -776,12 +855,22 @@ class RouterHandler(BaseHTTPRequestHandler):
             # pressure, last flight anomaly — in one gateway round trip.
             # tools/tputop.py renders this; ages tell a dashboard how stale
             # each row is (a silent replica keeps its last sample + age).
-            self._respond_json(200, {
+            doc = {
                 "backends": list(self.pool.addrs()),
                 "cooling_down": self.pool.cooling(),
                 "draining": self.pool.draining(),
                 "replicas": self.pool.fleet(),
-            })
+            }
+            a = autoscaler.get()
+            if a.enabled:
+                doc["autoscale"] = a.status()
+            self._respond_json(200, doc)
+            return
+        if self.path.split("?")[0] == "/debug/autoscale":
+            # The controller's own view: committed target vs actual,
+            # standby/draining/stuck counts, decision journal head —
+            # deploy/probes.py L3 and tools/tputop.py read this.
+            self._respond_json(200, autoscaler.get().status())
             return
         if self.path.split("?")[0] == "/debug/capacity":
             # Fleet capacity aggregation: per-replica offered load vs
@@ -801,6 +890,15 @@ class RouterHandler(BaseHTTPRequestHandler):
             affinity_key = _affinity_key(path, body)
         candidates = self.pool.pick(affinity_key)
         self.metrics.backends.set(len(self.pool._addrs))
+        if not candidates and method == "POST" \
+                and path.startswith("/v1/") and autoscaler.get().enabled:
+            # Scale-to-zero wake-up: the fleet is parked and a request
+            # arrived. Hold THIS request (bounded) while the autoscaler
+            # promotes a standby or cold-starts a replica (AOT-backed:
+            # the wait is the manifest ready-time, not a full compile),
+            # then re-pick. A standby promotion resolves in ~one tick.
+            if autoscaler.get().request_cold_start():
+                candidates = self.pool.pick(affinity_key)
         if not candidates:
             self.metrics.requests.inc(code="503")
             self._respond_json(503, {"error": {
@@ -1171,13 +1269,24 @@ class RouterHandler(BaseHTTPRequestHandler):
 
 
 def serve(backend_service: str, host: str, port: int,
-          otlp_endpoint: str = "", trace_sample: float = 1.0):
+          otlp_endpoint: str = "", trace_sample: float = 1.0,
+          autoscale: bool = False, autoscale_launch_cmd: str = "",
+          autoscale_kw: dict | None = None):
     RouterHandler.pool = BackendPool(backend_service)
     RouterHandler.metrics = RouterMetrics()
     RouterHandler.tracer = tracing.build_tracer(
         "tpu-serve-router", endpoint=otlp_endpoint or None,
         sample=trace_sample)
     start_load_poller(RouterHandler.pool, metrics=RouterHandler.metrics)
+    if autoscale:
+        a = autoscaler.configure(enabled=True, **(autoscale_kw or {}))
+        launcher = None
+        if autoscale_launch_cmd:
+            launcher = autoscaler.CommandLauncher(autoscale_launch_cmd)
+        a.install(pool=RouterHandler.pool, launcher=launcher)
+        for addr in RouterHandler.pool.addrs():
+            a.adopt(addr)
+        a.start()
     httpd = ThreadingHTTPServer((host, port), RouterHandler)
     log.info("router listening on %s:%d -> %s", host, port, backend_service)
     httpd.serve_forever()
@@ -1197,9 +1306,50 @@ def main(argv=None):
                         "spans stay local")
     p.add_argument("--trace-sample", type=float, default=1.0,
                    help="root-span sampling probability in [0, 1]")
+    p.add_argument("--autoscale", type=int, default=0,
+                   help="1 = run the replica autoscaler in this gateway: "
+                        "consume /debug/capacity's fleet recommendation, "
+                        "launch/drain replicas to match (serving/"
+                        "autoscaler.py)")
+    p.add_argument("--autoscale-launch-cmd", default="",
+                   help="replica launch command template with a {port} "
+                        "placeholder (CommandLauncher); empty = the "
+                        "autoscaler can only drain/adopt, never launch")
+    p.add_argument("--autoscale-min", type=int, default=1,
+                   help="replica floor (0 enables scale-to-zero: an idle "
+                        "fleet parks and the first request cold-starts it)")
+    p.add_argument("--autoscale-max", type=int, default=8,
+                   help="replica ceiling")
+    p.add_argument("--autoscale-standby", type=int, default=-1,
+                   help="prewarmed standby replicas kept ready out of "
+                        "rotation (-1 = derive from the AOT ready-time)")
+    p.add_argument("--autoscale-interval", type=float,
+                   default=autoscaler.DEFAULT_INTERVAL_S,
+                   help="reconcile tick seconds")
+    p.add_argument("--autoscale-stable", type=float,
+                   default=autoscaler.DEFAULT_STABLE_S,
+                   help="hysteresis: a target change must persist this "
+                        "long before it commits")
+    p.add_argument("--autoscale-cooldown", type=float,
+                   default=autoscaler.DEFAULT_COOLDOWN_S,
+                   help="minimum seconds between direction reversals "
+                        "(flap suppression)")
+    p.add_argument("--autoscale-idle-timeout", type=float,
+                   default=autoscaler.DEFAULT_IDLE_TIMEOUT_S,
+                   help="idle seconds before scale-to-zero parks the "
+                        "fleet (only with --autoscale-min 0)")
     args = p.parse_args(argv)
     serve(args.backend_service, args.host, args.port,
-          otlp_endpoint=args.otlp_endpoint, trace_sample=args.trace_sample)
+          otlp_endpoint=args.otlp_endpoint, trace_sample=args.trace_sample,
+          autoscale=bool(args.autoscale),
+          autoscale_launch_cmd=args.autoscale_launch_cmd,
+          autoscale_kw=dict(min_replicas=args.autoscale_min,
+                            max_replicas=args.autoscale_max,
+                            standby=args.autoscale_standby,
+                            interval_s=args.autoscale_interval,
+                            stable_s=args.autoscale_stable,
+                            cooldown_s=args.autoscale_cooldown,
+                            idle_timeout_s=args.autoscale_idle_timeout))
 
 
 if __name__ == "__main__":

@@ -28,9 +28,9 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
-from aws_k8s_ansible_provisioner_tpu.serving import (capacity, devmon,
-                                                     flightrec, metrics, slo,
-                                                     tracing)
+from aws_k8s_ansible_provisioner_tpu.serving import (autoscaler, capacity,
+                                                     devmon, flightrec,
+                                                     metrics, slo, tracing)
 from aws_k8s_ansible_provisioner_tpu.serving.engine import (
     ContextLengthExceeded, EngineOverloaded)
 
@@ -335,6 +335,9 @@ class Handler(BaseHTTPRequestHandler):
             slo.get().export()       # refresh the burn-rate gauges
             devmon.get().export()    # refresh the tpu_device_* family
             capacity.get().export()  # refresh tpu_capacity_* (drop-not-fail)
+            autoscaler.get().export()  # refresh tpu_autoscale_* (R12: the
+            # replica process has no controller, so these render at their
+            # defaults — same both-routes contract as the gateway families)
             # Content negotiation: OpenMetrics (exemplars + # EOF) when the
             # scraper asks for it, classic Prometheus text otherwise.
             om = "application/openmetrics-text" in \
@@ -345,6 +348,7 @@ class Handler(BaseHTTPRequestHandler):
                     + slo.metrics.registry.render(om)
                     + devmon.metrics.registry.render(om)
                     + capacity.metrics.registry.render(om)
+                    + autoscaler.metrics.registry.render(om)
                     + metrics.pipeline.registry.render(om)
                     + render_engine_chips())
             if om:

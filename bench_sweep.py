@@ -494,6 +494,238 @@ def overload_bench(levels, n_replicas: int, n_requests: int,
     return 0 if ok else 1
 
 
+def autoscale_ramp_bench(levels, phase_s: float, max_replicas: int,
+                         out_path: str) -> int:
+    """Offered-load ramp against the SELF-SCALING router (CPU): the fleet
+    starts at one replica, the autoscaler closes the loop from the
+    capacity plane's replica recommendation to actual replica count, and
+    the artifact records what an operator would watch — offered load,
+    replica count, and shed rate over time.
+
+    Levels are client-concurrency phases, each held for ``phase_s``
+    seconds (e.g. 1,6,6,1 = calm, ramp, plateau, cool-down). Replicas run
+    deliberately TIGHT admission (2 slots, queue depth 2) so a one-replica
+    fleet saturates at low concurrency on CPU. The expected shape: shed
+    spikes when the ramp first lands, the controller launches replicas,
+    shed decays as they admit, and the cool-down phase drains the fleet
+    back without client-visible errors (non-429 failures are counted
+    separately — they are the number the drain path promises is zero)."""
+    import threading
+    import urllib.error
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")   # actuation mechanics, not chip perf
+    import jax.numpy as jnp
+
+    from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+    from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+    from aws_k8s_ansible_provisioner_tpu.serving import autoscaler
+    from aws_k8s_ansible_provisioner_tpu.serving.router import (
+        BackendPool, RouterHandler, RouterMetrics, start_load_poller)
+    from aws_k8s_ansible_provisioner_tpu.serving.server import (
+        build_state, serve)
+    from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+    BASE = 18700
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size,
+                     eos_token_id=tok.eos_token_id, max_seq_len=256)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    stops: dict = {}
+    seq = [0]
+
+    def spawn():
+        """In-process ReplicaLauncher spawn: port out, server thread up,
+        addr returned immediately — readiness is the autoscaler's /readyz
+        probe, exactly as with an out-of-process launcher."""
+        seq[0] += 1
+        port = BASE + seq[0]
+        # short capacity window: shed evidence must decay within the
+        # cool-down phase or the recommendation pins the fleet high
+        serving = ServingConfig(model="tiny-qwen3", max_decode_slots=2,
+                                max_cache_len=256, prefill_buckets=(32, 64),
+                                max_queue_depth=2, dtype="float32",
+                                capacity_window_s=8.0)
+        state = build_state(serving, model_cfg=cfg, params=params,
+                            tokenizer=tok)
+        ready, stop = threading.Event(), threading.Event()
+        threading.Thread(target=serve,
+                         args=(state, "127.0.0.1", port, ready, stop),
+                         daemon=True).start()
+        addr = f"127.0.0.1:{port}"
+        stops[addr] = stop
+        return addr, stop
+
+    def terminate(addr, stop):
+        stop.set()
+        stops.pop(addr, None)
+
+    first_addr, _ = spawn()
+    # wait for the seed replica ourselves; the autoscaler adopts it ready
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{first_addr}/readyz", timeout=2) as r:
+                if r.status == 200:
+                    break
+        except Exception:   # noqa: BLE001 — still booting
+            pass
+        time.sleep(0.2)
+
+    RouterHandler.pool = BackendPool(first_addr, cooldown_s=5.0)
+    RouterHandler.metrics = RouterMetrics()
+    poll_stop = threading.Event()
+    start_load_poller(RouterHandler.pool, interval_s=0.2, stop=poll_stop)
+    router = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    rurl = f"http://127.0.0.1:{router.server_port}"
+
+    a = autoscaler.configure(
+        enabled=True, min_replicas=1, max_replicas=max_replicas,
+        interval_s=0.25, stable_s=1.0, cooldown_s=3.0, standby=0,
+        idle_timeout_s=30.0, ready_timeout_s=60.0)
+    a.install(pool=RouterHandler.pool,
+              launcher=autoscaler.CallableLauncher(spawn, terminate))
+    a.adopt(first_addr)
+    a.start()
+
+    t0 = time.monotonic()
+    timeline = []
+    sampler_stop = threading.Event()
+    conc_now = [0]
+
+    def sampler():
+        while not sampler_stop.is_set():
+            st = a.status()
+            timeline.append({
+                "t_s": round(time.monotonic() - t0, 2),
+                "offered_conc": conc_now[0],
+                "replicas": st["actual"],
+                "desired": st["desired"],
+                "launching": st["launching"],
+                "draining": st["draining"],
+            })
+            sampler_stop.wait(0.5)
+
+    threading.Thread(target=sampler, daemon=True).start()
+
+    phases = []
+    total_shed = total_done = total_failed = 0
+    for conc in levels:
+        conc_now[0] = conc
+        lock = threading.Lock()
+        done, shed, errors = [], [], []
+        phase_end = time.monotonic() + phase_s
+
+        def client():
+            i = 0
+            while time.monotonic() < phase_end:
+                i += 1
+                body = json.dumps({
+                    "model": "tiny-qwen3", "max_tokens": 8,
+                    "prompt": f"ramp probe {i}", "ignore_eos": True,
+                }).encode()
+                req = urllib.request.Request(
+                    rurl + "/v1/completions", data=body,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        r.read()
+                    with lock:
+                        done.append(i)
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    with lock:
+                        (shed if e.code == 429 else errors).append(e.code)
+                except Exception as e:     # noqa: BLE001 — record, don't die
+                    with lock:
+                        errors.append(str(e)[:60])
+
+        threads = [threading.Thread(target=client) for _ in range(conc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        st = a.status()
+        offered = len(done) + len(shed) + len(errors)
+        phases.append({
+            "concurrency": conc,
+            "phase_s": phase_s,
+            "offered": offered,
+            "completed": len(done),
+            "shed": len(shed),
+            "failed": len(errors),
+            "shed_rate": round(len(shed) / max(offered, 1), 3),
+            "completed_rps": round(len(done) / phase_s, 2),
+            "replicas_at_end": st["actual"],
+            "desired_at_end": st["desired"],
+        })
+        total_done += len(done)
+        total_shed += len(shed)
+        total_failed += len(errors)
+        sys.stderr.write(f"autoscale-ramp: conc={conc} -> {phases[-1]}\n")
+
+    # let the cool-down drain settle before reading the final fleet size
+    settle_end = time.monotonic() + 15.0
+    while time.monotonic() < settle_end:
+        st = a.status()
+        if st["actual"] <= 1 and st["draining"] == 0:
+            break
+        time.sleep(0.5)
+    sampler_stop.set()
+    final = a.status()
+    a.stop()
+    poll_stop.set()
+    router.shutdown()
+    for stop in list(stops.values()):
+        stop.set()
+
+    first_up = next((p["t_s"] for p in timeline if p["replicas"] > 1), None)
+    ramp_t0 = next((p["t_s"] for p in timeline if p["offered_conc"] > levels[0]),
+                   0.0)
+    result = {
+        "mode": "autoscale_ramp",
+        "platform": "cpu",
+        "levels": list(levels),
+        "phase_s": phase_s,
+        "max_replicas": max_replicas,
+        "slots_per_replica": 2,
+        "max_queue_depth": 2,
+        "ramp": {
+            "time_to_first_scale_up_s":
+                round(first_up - ramp_t0, 2) if first_up is not None else None,
+            "peak_replicas": max(p["replicas"] for p in timeline),
+            "peak_shed_rate": max(p["shed_rate"] for p in phases),
+            "completed_rps": max(p["completed_rps"] for p in phases),
+            "drain_errors": total_failed,
+            "final_replicas": final["actual"],
+        },
+        "controller": {
+            "scale_ups": final["scale_ups"],
+            "scale_downs": final["scale_downs"],
+            "flaps_suppressed": final["flaps_suppressed"],
+            "launch_failures": final["launch_failures"],
+        },
+        "phases": phases,
+        "timeline": timeline,
+        "totals": {"completed": total_done, "shed": total_shed,
+                   "failed": total_failed},
+    }
+    with open(out_path, "w") as f:
+        f.write(json.dumps(result, indent=1) + "\n")
+    print(json.dumps(result))
+    autoscaler.reset()
+    # sanity: the controller must actually have scaled, and surviving
+    # streams must not have seen non-429 failures
+    ok = (result["ramp"]["peak_replicas"] > 1 and total_failed == 0
+          and final["actual"] <= max(1, levels[-1]))
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cap", type=float, default=420.0,
@@ -532,7 +764,23 @@ def main() -> int:
                     help="requests fired per concurrency level")
     ap.add_argument("--overload-replicas", type=int, default=2)
     ap.add_argument("--overload-out", default="OVERLOAD_BENCH.json")
+    ap.add_argument("--autoscale-ramp", action="store_true",
+                    help="autoscale ramp mode (CPU): ramp offered load "
+                         "through the self-scaling router and write the "
+                         "offered-load / replica-count / shed-rate "
+                         "timeline (AUTOSCALE_BENCH.json)")
+    ap.add_argument("--autoscale-levels", default="1,6,6,1",
+                    help="comma-separated client-concurrency phases")
+    ap.add_argument("--autoscale-phase-s", type=float, default=8.0,
+                    help="seconds each concurrency phase is held")
+    ap.add_argument("--autoscale-max", type=int, default=4,
+                    help="controller max_replicas during the ramp")
+    ap.add_argument("--autoscale-out", default="AUTOSCALE_BENCH.json")
     args = ap.parse_args()
+    if args.autoscale_ramp:
+        levels = [int(x) for x in args.autoscale_levels.split(",") if x]
+        return autoscale_ramp_bench(levels, args.autoscale_phase_s,
+                                    args.autoscale_max, args.autoscale_out)
     if args.overload:
         levels = [int(x) for x in args.overload_levels.split(",") if x]
         return overload_bench(levels, args.overload_replicas,

@@ -217,6 +217,54 @@ def _slo_burn_threshold() -> Optional[float]:
         return 1.0
 
 
+def _autoscale_target(gv: Dict, inventory: Optional[str]) -> Optional[str]:
+    """Where to read the fleet controller's /debug/autoscale from.
+    TPU_PROBE_AUTOSCALE: '0'/'off' -> leg disabled, anything else -> that
+    router host:port. Unset -> the rehearsal gateway override if present,
+    else the gateway service (only when an inventory grounds the kubectl
+    lookup; env-only probe runs skip the leg quietly)."""
+    raw = os.environ.get("TPU_PROBE_AUTOSCALE", "").strip()
+    if raw.lower() in ("0", "0.0", "off"):
+        return None
+    if raw:
+        return raw
+    if os.environ.get("REHEARSE_GW_ADDR", ""):
+        return os.environ["REHEARSE_GW_ADDR"]
+    if inventory:
+        return gateway_addr(gv, inventory)
+    return None
+
+
+def _autoscale_detail(gv: Dict, inventory: Optional[str]) -> str:
+    """NON-REPAIRING autoscale leg: ``autoscale: ok|scaling(n→m)|stuck``.
+    A fleet mid-scale is the controller doing its job — tearing anything
+    down would fight the actuator; even ``stuck`` (a drain that outlived
+    its escalation window) is the controller's to resolve, the detail
+    just tells the operator where to look (/debug/autoscale, the flight
+    recorder's autoscale_decision events). Router unreachable or
+    controller disabled = pre-autoscale build: silently skipped."""
+    target = _autoscale_target(gv, inventory)
+    if not target:
+        return ""
+    status, body = _http_get(f"http://{target}/debug/autoscale")
+    if status != 200:
+        return ""
+    try:
+        a = json.loads(body)
+    except ValueError:
+        return ""
+    if not isinstance(a, dict) or not a.get("enabled"):
+        return ""
+    if a.get("stuck"):
+        state = "stuck"
+    elif (a.get("desired") != a.get("actual")
+            or a.get("launching") or a.get("draining")):
+        state = f"scaling({a.get('actual')}→{a.get('desired')})"
+    else:
+        state = "ok"
+    return ", autoscale: " + state
+
+
 def probe_l3(gv: Dict, inventory: Optional[str]) -> ProbeResult:
     addrs = replica_addrs(gv, inventory)
     if not addrs:
@@ -281,7 +329,8 @@ def probe_l3(gv: Dict, inventory: Optional[str]) -> ProbeResult:
     return ProbeResult("L3", not bad,
                        f"{len(addrs)} replica(s) "
                        + ("all ready" if not bad else "; ".join(bad))
-                       + slo_detail + drift_detail + cap_detail)
+                       + slo_detail + drift_detail + cap_detail
+                       + _autoscale_detail(gv, inventory))
 
 
 def gateway_addr(gv: Dict, inventory: Optional[str]) -> str:

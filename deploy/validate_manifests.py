@@ -186,6 +186,32 @@ def _validate_workload(d: dict, name: str):
                             "flags — the capacity ceiling would fall back "
                             "to the instantaneous engine gauge instead of "
                             "the roofline-blended service rate")
+        # Autoscale pairing (serving/autoscaler.py): --autoscale-min 0
+        # enables scale-to-zero — the whole fleet parks when idle and the
+        # first request cold-starts a replica. Without a launch command
+        # the controller can only drain/adopt existing replicas, so a
+        # parked fleet could NEVER come back: every /v1/* request would
+        # 503 until an operator scaled the Deployment by hand. Enabled
+        # autoscale with a zero floor therefore requires a launcher.
+        if "--autoscale" in argv:
+            i = argv.index("--autoscale")
+            enabled = str(argv[i + 1]).strip() not in ("0", "")  \
+                if i + 1 < len(argv) else False
+            floor = None
+            if "--autoscale-min" in argv:
+                j = argv.index("--autoscale-min")
+                floor = str(argv[j + 1]).strip() if j + 1 < len(argv) else None
+            has_launcher = any(
+                isinstance(a, str) and a == "--autoscale-launch-cmd"
+                and argv.index(a) + 1 < len(argv)
+                and str(argv[argv.index(a) + 1]).strip()
+                for a in argv)
+            if enabled and floor == "0" and not has_launcher:
+                _fail(name, f"{kind} {mname} container {c.get('name')} "
+                            "enables --autoscale with --autoscale-min 0 "
+                            "but no --autoscale-launch-cmd — a parked "
+                            "fleet would have no way to cold-start "
+                            "(scale-to-zero requires a launcher)")
         # Compile-cache pairing (AOT cold-start work, serving/aot.py): a
         # JAX_COMPILATION_CACHE_DIR env must point INSIDE a declared
         # volumeMount of the same container — a cache on the container's

@@ -1,0 +1,733 @@
+"""Fleet actuation (serving/autoscaler.py): the self-scaling replica
+controller that closes the loop from /debug/capacity's fleet replica
+recommendation to actual replica count.
+
+The controller is a reconciliation loop over an injectable monotonic
+clock, so every hysteresis commit, cooldown block, backoff retry and
+drain escalation under test is exact scripted arithmetic — no sleeps, no
+flakes. Contracts pinned here:
+
+- hysteresis + cooldown: a noisy forecast produces AT MOST one direction
+  change per cooldown window, and suppressed reversals are counted
+  (``flaps_suppressed``), never actuated;
+- the injected ``autoscale_launch_error`` chaos fault degrades by
+  classification: transient failures retry on the deterministic capped
+  backoff schedule (miniansible.backoff_schedule — clock- and RNG-free),
+  fatal failures give up and are journaled; the controller keeps
+  reconciling either way (drop-not-fail);
+- the injected ``autoscale_drain_stuck`` chaos fault wedges a drain: the
+  replica is flagged ``stuck`` after drain_stuck_s and ESCALATED (reaped)
+  after drain_escalate_s instead of wedging the controller;
+- scale-to-zero: an idle fleet drains to parked, the prewarmed standby
+  pool survives the park, and the first request promotes a standby (the
+  cold start is one pool insert, not a launch);
+- ramp end-to-end through REAL replicas and the REAL capacity loop: the
+  fleet scales up while admission is shedding, serves the plateau, drains
+  back down after the ramp, and every admitted request — including those
+  served mid-drain — returns an intact stream (zero non-429 failures,
+  full token budget), with quiet-fleet completions byte-identical across
+  the scale cycle;
+- tpu_autoscale_* renders on BOTH /metrics routes (engine + router) with
+  the single-writer export discipline (tpulint R12).
+
+``make autoscale-smoke`` runs this file alone; tier-1 runs the scripted
+-clock portion via the ``not slow`` selection.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aws_k8s_ansible_provisioner_tpu.config import ServingConfig, tiny_qwen3
+from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+from aws_k8s_ansible_provisioner_tpu.serving import autoscaler, capacity
+from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
+from aws_k8s_ansible_provisioner_tpu.serving import devmon, flightrec, slo
+from aws_k8s_ansible_provisioner_tpu.serving.autoscaler import (
+    Autoscaler, CallableLauncher, CommandLauncher, backoff_schedule)
+from aws_k8s_ansible_provisioner_tpu.serving.router import (
+    BackendPool, RouterHandler, RouterMetrics, start_load_poller)
+from aws_k8s_ansible_provisioner_tpu.serving.server import build_state, serve
+from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+pytestmark = pytest.mark.autoscale_smoke
+
+MODEL = "tiny-qwen3"
+_PORTS = iter(range(19000, 19060))
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    for mod in (autoscaler, capacity, devmon, flightrec, slo, _chaos):
+        mod.reset()
+    yield
+    for mod in (autoscaler, capacity, devmon, flightrec, slo, _chaos):
+        mod.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size,
+                     eos_token_id=tok.eos_token_id, max_seq_len=256)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return tok, cfg, params
+
+
+def _fake_fleet(clock, **kw):
+    """An Autoscaler over a scripted clock and an in-memory fleet: the
+    launcher 'spawns' addresses, readiness is a set, inflight a dict."""
+    seq = [0]
+    world = {"ready": set(), "inflight": {}, "stopped": []}
+
+    def spawn():
+        seq[0] += 1
+        return f"10.0.0.{seq[0]}:80", f"proc{seq[0]}"
+
+    def stop(addr, opaque):
+        world["stopped"].append(addr)
+
+    rec = {"recommended_replicas": 1, "offered_tps": 1.0,
+           "reporting_replicas": 1}
+    defaults = dict(enabled=True, min_replicas=1, max_replicas=8,
+                    stable_s=1.0, cooldown_s=10.0, standby=0, clock=clock)
+    defaults.update(kw)
+    a = Autoscaler(**defaults)
+    a.install(launcher=CallableLauncher(spawn, stop),
+              ready_fn=lambda ad: ad in world["ready"],
+              inflight_fn=lambda ad: world["inflight"].get(ad, 0),
+              drain_fn=lambda ad: True,
+              recommend_fn=lambda: rec)
+    return a, world, rec
+
+
+def _all_ready(a, world):
+    world["ready"].update(h.addr for h in a._replicas.values())
+
+
+# ---------------------------------------------------------------------------
+# hysteresis, cooldown, flap suppression (scripted clock — exact)
+# ---------------------------------------------------------------------------
+
+
+def test_scale_up_commits_only_after_stable_window():
+    clk = FakeClock()
+    a, world, rec = _fake_fleet(clk, stable_s=2.0)
+    a.step()                                 # bootstrap: target 1, launch 1
+    _all_ready(a, world)
+    clk.t = 0.5
+    a.step()                                 # admit
+    assert a.status()["actual"] == 1
+    rec["recommended_replicas"] = 3
+    clk.t = 1.0
+    a.step()                                 # proposal starts, no commit
+    assert a.status()["desired"] == 1 and a.status()["launching"] == 0
+    clk.t = 2.5
+    a.step()                                 # 1.5s < stable_s: still held
+    assert a.status()["desired"] == 1
+    clk.t = 3.0
+    a.step()                                 # 2.0s: commit + launch 2
+    st = a.status()
+    assert st["desired"] == 3 and st["launching"] == 2
+    assert st["scale_ups"] == 1
+    _all_ready(a, world)
+    clk.t = 3.5
+    a.step()
+    assert a.status()["actual"] == 3
+
+
+def test_noisy_forecast_flaps_at_most_once_per_cooldown_window():
+    """The acceptance bound: <= 1 direction change per cooldown window
+    under a forecast that flips every tick."""
+    clk = FakeClock()
+    a, world, rec = _fake_fleet(clk, stable_s=1.0, cooldown_s=10.0)
+    a.step()
+    _all_ready(a, world)
+    clk.t = 0.5
+    a.step()
+    # noisy: alternate 3 and 1 every 0.6s for two full cooldown windows
+    commits = []
+    last = a.status()["desired"]
+    t = 1.0
+    while t < 21.0:
+        rec["recommended_replicas"] = 3 if int(t / 0.6) % 2 == 0 else 1
+        clk.t = t
+        a.step()
+        _all_ready(a, world)
+        cur = a.status()["desired"]
+        if cur != last:
+            commits.append((t, last, cur))
+            last = cur
+        t += 0.6
+    # a flip-flopping forecast never holds a proposal stable_s long, so
+    # nothing commits at all — strictly within the <=1-per-window bound
+    for w0 in (1.0, 11.0):
+        in_window = [c for c in commits if w0 <= c[0] < w0 + 10.0]
+        assert len(in_window) <= 1, commits
+
+
+def test_reversal_inside_cooldown_is_suppressed_and_counted():
+    clk = FakeClock()
+    a, world, rec = _fake_fleet(clk, stable_s=1.0, cooldown_s=10.0,
+                                max_replicas=4)
+    a.step()
+    _all_ready(a, world)
+    clk.t = 0.5
+    a.step()
+    rec["recommended_replicas"] = 3
+    clk.t = 1.0
+    a.step()
+    clk.t = 2.0
+    a.step()                                 # commit up at t=2
+    assert a.status()["desired"] == 3
+    _all_ready(a, world)
+    clk.t = 2.5
+    a.step()
+    assert a.status()["actual"] == 3
+    # immediate reversal: held stable_s long but inside the cooldown
+    rec["recommended_replicas"] = 1
+    clk.t = 3.0
+    a.step()
+    clk.t = 4.5
+    a.step()
+    st = a.status()
+    assert st["desired"] == 3                # NOT committed
+    assert st["flaps_suppressed"] == 1
+    assert st["last_decision"] == "flap_suppressed"
+    # once the cooldown from the t=2 commit expires, the held reversal
+    # commits on the next tick
+    clk.t = 12.5
+    a.step()
+    assert a.status()["desired"] == 1
+    assert a.status()["scale_downs"] == 1
+
+
+# ---------------------------------------------------------------------------
+# launch failures: chaos 'autoscale_launch_error' (R6) + deterministic backoff
+# ---------------------------------------------------------------------------
+
+
+def test_transient_launch_failure_retries_on_deterministic_backoff():
+    """chaos fault autoscale_launch_error (transient mode): the launch
+    raises, classify_failure reads it transient, and the retry lands at
+    exactly backoff_schedule()'s first delay — clock-free, RNG-free."""
+    clk = FakeClock()
+    a, world, rec = _fake_fleet(clk, stable_s=0.0, launch_retries=3,
+                                backoff_base_s=2.0)
+    _chaos.get().inject("autoscale_launch_error", times=1)
+    a.step()                                 # bootstrap launch fails
+    st = a.status()
+    assert st["launch_failures"] == {"transient": 1, "fatal": 0}
+    assert st["pending_launches"] == 1       # queued for retry, not dead
+    assert st["last_decision"] == "launch_retry"
+    # the pending entry's due time is the schedule's first figure for the
+    # same seed — recompute it and step to just before / just after
+    entry = a._pending[0]
+    delay = backoff_schedule(2.0, 1, entry["seed"])[0]
+    assert entry["next_t"] == pytest.approx(delay)
+    clk.t = delay - 0.01
+    a.step()
+    assert a.status()["launching"] == 0      # not due yet
+    clk.t = delay + 0.01
+    a.step()                                 # retry fires (fault exhausted)
+    assert a.status()["launching"] == 1
+    _all_ready(a, world)
+    clk.t = delay + 0.5
+    a.step()
+    assert a.status()["actual"] == 1
+    assert world["stopped"] == []            # nothing was torn down
+
+
+def test_fatal_launch_failure_gives_up_without_wedging():
+    """chaos fault autoscale_launch_error (mode=fatal): classified fatal,
+    no retry is queued, the decision is journaled, and the controller
+    keeps reconciling (drop-not-fail — the next tick launches afresh)."""
+    clk = FakeClock()
+    a, world, rec = _fake_fleet(clk, stable_s=0.0)
+    _chaos.get().inject("autoscale_launch_error", times=1, mode="fatal")
+    a.step()
+    st = a.status()
+    assert st["launch_failures"] == {"transient": 0, "fatal": 1}
+    assert st["pending_launches"] == 0       # fatal: not retried
+    assert st["last_decision"] == "launch_failed"
+    # the controller is not wedged: the next reconcile tick tries again
+    clk.t = 1.0
+    a.step()
+    assert a.status()["launching"] == 1
+    evts = [e for e in flightrec.get().tail(100)
+            if e.get("type") == "autoscale_decision"]
+    assert any(e.get("decision") == "launch_failed" for e in evts)
+
+
+def test_launch_retries_cap_then_give_up():
+    """Every attempt re-fails transient (autoscale_launch_error forever):
+    the retry chain stops at launch_retries, and the reconcile loop keeps
+    running (a fresh launch seed starts a fresh chain next tick)."""
+    clk = FakeClock()
+    a, world, rec = _fake_fleet(clk, stable_s=0.0, launch_retries=2,
+                                backoff_base_s=0.5)
+    _chaos.get().inject("autoscale_launch_error", times=-1)
+    a.step()
+    for t in (5.0, 10.0, 15.0, 20.0, 25.0):
+        clk.t = t
+        a.step()
+    st = a.status()
+    assert st["launch_failures"]["transient"] >= 3
+    assert st["pending_launches"] in (0, 1)  # never a runaway retry queue
+    assert st["actual"] == 0                 # and never a phantom replica
+    _chaos.get().clear()
+
+
+# ---------------------------------------------------------------------------
+# drain lifecycle: chaos 'autoscale_drain_stuck' (R6) escalation
+# ---------------------------------------------------------------------------
+
+
+def test_stuck_drain_flags_then_escalates_instead_of_wedging():
+    """chaos fault autoscale_drain_stuck: inflight never reaches zero, so
+    the drain is flagged ``stuck`` after drain_stuck_s and force-reaped
+    after drain_escalate_s — the fleet converges anyway (drop-not-fail:
+    one replica's wedge never stalls the reconcile loop)."""
+    clk = FakeClock()
+    a, world, rec = _fake_fleet(clk, stable_s=1.0, cooldown_s=2.0,
+                                drain_stuck_s=5.0, drain_escalate_s=10.0,
+                                max_replicas=4)
+    rec["recommended_replicas"] = 2
+    a.step()
+    _all_ready(a, world)
+    clk.t = 1.0
+    a.step()                                 # commit to 2, launch second
+    clk.t = 2.0
+    a.step()
+    _all_ready(a, world)
+    clk.t = 2.5
+    a.step()
+    assert a.status()["actual"] == 2
+    _chaos.get().inject("autoscale_drain_stuck", times=-1)
+    rec["recommended_replicas"] = 1
+    clk.t = 5.0
+    a.step()                                 # proposal
+    clk.t = 6.5
+    a.step()                                 # commit + drain starts
+    assert a.status()["draining"] == 1
+    clk.t = 12.0
+    a.step()                                 # 5.5s draining -> stuck
+    st = a.status()
+    assert st["stuck"] == 1 and st["last_decision"] == "drain_stuck"
+    clk.t = 17.0
+    a.step()                                 # 10.5s -> escalated + reaped
+    st = a.status()
+    assert st["draining"] == 0 and st["stuck"] == 0 and st["actual"] == 1
+    assert len(world["stopped"]) == 1
+    evts = [e.get("decision") for e in flightrec.get().tail(100)
+            if e.get("type") == "autoscale_decision"]
+    assert "drain_escalated" in evts
+    _chaos.get().clear()
+
+
+def test_clean_drain_waits_for_inflight_zero_then_reaps():
+    clk = FakeClock()
+    a, world, rec = _fake_fleet(clk, stable_s=0.5, cooldown_s=1.0,
+                                max_replicas=4)
+    rec["recommended_replicas"] = 2
+    a.step()
+    _all_ready(a, world)
+    clk.t = 0.6
+    a.step()
+    _all_ready(a, world)
+    clk.t = 1.2
+    a.step()
+    assert a.status()["actual"] == 2
+    rec["recommended_replicas"] = 1
+    clk.t = 3.0
+    a.step()
+    clk.t = 3.6
+    a.step()                                 # commit + drain
+    victim = next(h.addr for h in a._replicas.values()
+                  if h.state == autoscaler.DRAINING)
+    # the victim still holds one stream: reap must wait
+    world["inflight"][victim] = 1
+    clk.t = 4.0
+    a.step()
+    assert a.status()["draining"] == 1
+    world["inflight"][victim] = 0
+    clk.t = 4.5
+    a.step()                                 # inflight 0 -> reaped
+    st = a.status()
+    assert st["draining"] == 0 and st["actual"] == 1
+    assert world["stopped"] == [victim]
+
+
+# ---------------------------------------------------------------------------
+# scale-to-zero + prewarmed standby (scripted clock)
+# ---------------------------------------------------------------------------
+
+
+def test_idle_fleet_parks_and_standby_survives():
+    clk = FakeClock()
+    a, world, rec = _fake_fleet(clk, min_replicas=0, stable_s=1.0,
+                                cooldown_s=2.0, idle_timeout_s=30.0,
+                                standby=1)
+    rec.update(recommended_replicas=1, offered_tps=0.0)
+    a.adopt("10.9.9.1:80")                   # the pre-existing fleet
+    world["ready"].add("10.9.9.1:80")
+    a.step()                                 # standby pool warms up
+    _all_ready(a, world)
+    clk.t = 1.0
+    a.step()
+    st = a.status()
+    assert st["actual"] == 1 and st["standby"] == 1
+    # 30 idle seconds later the serving replica drains away; the standby
+    # is parked OUT of rotation and survives
+    for t in (10.0, 20.0, 31.0, 32.5, 33.0, 34.0):
+        clk.t = t
+        a.step()
+    st = a.status()
+    assert st["parked"] is True and st["actual"] == 0 and st["standby"] == 1
+    assert st["scale_downs"] == 1
+    # parked stays parked: more idle ticks do not relaunch
+    clk.t = 60.0
+    a.step()
+    assert a.status()["actual"] == 0
+
+
+def test_cold_start_promotes_standby_immediately():
+    clk = FakeClock()
+    a, world, rec = _fake_fleet(clk, min_replicas=0, stable_s=1.0,
+                                cooldown_s=2.0, idle_timeout_s=10.0,
+                                standby=1)
+    rec.update(recommended_replicas=1, offered_tps=0.0)
+    a.adopt("10.9.9.1:80")
+    world["ready"].add("10.9.9.1:80")
+    a.step()
+    _all_ready(a, world)
+    for t in (1.0, 11.0, 12.5, 13.0, 14.0):
+        clk.t = t
+        a.step()
+    assert a.status()["parked"] is True
+    # first request: cold start promotes the prewarmed standby on the
+    # next tick — no launch, no /readyz wait, the ready-time was prepaid
+    got = []
+    th = threading.Thread(
+        target=lambda: got.append(a.request_cold_start(timeout_s=10.0)))
+    th.start()
+    deadline = time.monotonic() + 5.0
+    while not a.status()["cold_start_pending"] \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    clk.t = 15.0
+    a.step()
+    th.join(timeout=5.0)
+    assert got == [True]
+    st = a.status()
+    assert st["actual"] == 1 and st["standby"] == 0
+    assert st["cold_starts"] == 1
+    evts = [e.get("decision") for e in flightrec.get().tail(100)
+            if e.get("type") == "autoscale_decision"]
+    assert "cold_start" in evts and "promote_standby" in evts
+
+
+def test_standby_target_derived_from_ready_time():
+    # explicit size wins; -1 derives from the manifest ready-time: any
+    # nonzero cold start is worth one prewarmed replica
+    assert Autoscaler(standby=3).standby_target() == 3
+    assert Autoscaler(standby=-1, ready_s=5.5).standby_target() == 1
+    assert Autoscaler(standby=-1, ready_s=0.0).standby_target() == 0
+
+
+# ---------------------------------------------------------------------------
+# launchers + export
+# ---------------------------------------------------------------------------
+
+
+def test_command_launcher_requires_port_placeholder():
+    with pytest.raises(ValueError):
+        CommandLauncher("python -m http.server")
+    launcher = CommandLauncher("python -m serve --port {port}")
+    assert "{port}" in launcher.template
+
+
+def test_export_renders_the_autoscale_family():
+    a = Autoscaler(enabled=True)
+    assert a.export() is not None
+    text = autoscaler.metrics.registry.render()
+    for name in ("tpu_autoscale_desired_replicas",
+                 "tpu_autoscale_actual_replicas",
+                 "tpu_autoscale_launch_failures",
+                 "tpu_autoscale_flaps_suppressed",
+                 "tpu_autoscale_last_decision_age_s"):
+        assert name in text, name
+
+
+# ---------------------------------------------------------------------------
+# ramp end-to-end: real replicas, real capacity loop, real router
+# ---------------------------------------------------------------------------
+
+
+def _start_replica(model, port, stops):
+    tok, cfg, params = model
+    # deliberately TIGHT admission (2 slots, queue 2) so one replica
+    # saturates at low client concurrency on CPU; short capacity window
+    # so shed evidence decays fast enough for the drain-down leg
+    serving = ServingConfig(model=MODEL, max_decode_slots=2,
+                            max_cache_len=256, prefill_buckets=(32, 64),
+                            max_queue_depth=2, dtype="float32",
+                            capacity_window_s=4.0)
+    state = build_state(serving, model_cfg=cfg, params=params, tokenizer=tok)
+    ready, stop = threading.Event(), threading.Event()
+    threading.Thread(target=serve,
+                     args=(state, "127.0.0.1", port, ready, stop),
+                     daemon=True).start()
+    addr = f"127.0.0.1:{port}"
+    stops[addr] = stop
+    return addr, ready, stop
+
+
+def _start_router(pool):
+    RouterHandler.pool = pool
+    RouterHandler.metrics = RouterMetrics()
+    poll_stop = threading.Event()
+    start_load_poller(pool, interval_s=0.2, stop=poll_stop)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), RouterHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_port}", poll_stop
+
+
+def _post_completion(url, prompt, timeout=60):
+    body = json.dumps({"model": MODEL, "prompt": prompt, "max_tokens": 8,
+                       "ignore_eos": True}).encode()
+    req = urllib.request.Request(url + "/v1/completions", data=body,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _harvest_decisions(seen: set):
+    """Accumulate autoscale decisions from the flight-recorder ring.
+    Request traffic shares (and floods) the same bounded ring, so a
+    single tail() read at the END of a test misses early decisions —
+    harvest inside the wait loops instead."""
+    seen.update(e.get("decision") for e in flightrec.get().tail(4096)
+                if e.get("type") == "autoscale_decision")
+    return seen
+
+
+@pytest.mark.slow
+def test_ramp_scales_up_drains_down_and_streams_survive(model):
+    """The acceptance ramp: in-process ReplicaLauncher, seeded load
+    through the REAL router and the REAL capacity recommendation.
+    Replicas scale up while admission sheds, every admitted request
+    returns an intact full-budget stream (zero non-429 failures), the
+    fleet drains back down when the ramp passes, and quiet-fleet
+    completions are byte-identical before, during and after the cycle."""
+    stops: dict = {}
+
+    def spawn():
+        addr, _, _ = _start_replica(model, next(_PORTS), stops)
+        return addr, stops[addr]
+
+    def terminate(addr, stop):
+        stop.set()
+        stops.pop(addr, None)
+
+    first, ready, _ = _start_replica(model, next(_PORTS), stops)
+    assert ready.wait(120)
+    pool = BackendPool(first, cooldown_s=5.0)
+    router, rurl, poll_stop = _start_router(pool)
+
+    a = autoscaler.configure(
+        enabled=True, min_replicas=1, max_replicas=3, interval_s=0.25,
+        stable_s=0.75, cooldown_s=2.0, standby=0, idle_timeout_s=60.0,
+        ready_timeout_s=120.0)
+    a.install(pool=pool, launcher=CallableLauncher(spawn, terminate))
+    a.adopt(first)
+    a.start()
+    try:
+        # single-replica reference completion (deterministic decode)
+        reference = _post_completion(rurl, "ramp ref")["choices"][0]["text"]
+        assert reference
+
+        results = {"bad": [], "truncated": 0, "ok": 0}
+        lock = threading.Lock()
+        run = threading.Event()
+        run.set()
+
+        def client(cid):
+            i = 0
+            while run.is_set():
+                i += 1
+                try:
+                    out = _post_completion(rurl, f"ramp load {cid} {i}",
+                                           timeout=30)
+                    with lock:
+                        results["ok"] += 1
+                        # survivors must carry the FULL token budget —
+                        # a drain that truncates a stream shows up here
+                        if out["usage"]["completion_tokens"] != 8 \
+                                or not out["choices"][0]["text"]:
+                            results["truncated"] += 1
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    if e.code != 429:
+                        with lock:
+                            results["bad"].append(e.code)
+                except Exception as e:  # noqa: BLE001 — record, don't die
+                    with lock:
+                        results["bad"].append(str(e)[:80])
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(5)]
+        for t in threads:
+            t.start()
+        # hold the load until the controller has actually scaled up
+        decisions: set = set()
+        deadline = time.monotonic() + 90
+        peak = 1
+        while time.monotonic() < deadline:
+            st = a.status()
+            peak = max(peak, st["actual"])
+            _harvest_decisions(decisions)
+            if peak >= 2 and st["launching"] == 0:
+                break
+            time.sleep(0.25)
+        time.sleep(2.0)                      # serve the plateau a beat
+        run.clear()
+        for t in threads:
+            t.join(timeout=60)
+        assert peak >= 2, f"never scaled up: {a.status()}"
+        assert results["bad"] == [], results
+        assert results["truncated"] == 0 and results["ok"] > 0, results
+
+        # ramp passed: offered load decays within the capacity window,
+        # the recommendation falls, and the fleet drains back to min —
+        # quiet-fleet requests issued DURING the drain must byte-match
+        # the pre-ramp reference
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            st = a.status()
+            _harvest_decisions(decisions)
+            if st["actual"] <= 1 and st["draining"] == 0:
+                break
+            try:
+                out = _post_completion(rurl, "ramp ref")
+                assert out["choices"][0]["text"] == reference
+            except urllib.error.HTTPError as e:
+                e.read()
+                assert e.code == 429, e.code
+            time.sleep(0.5)
+        st = a.status()
+        assert st["actual"] == 1 and st["draining"] == 0, st
+        assert st["scale_downs"] >= 1, st
+
+        # post-drain: the surviving replica serves the same bytes
+        assert _post_completion(rurl, "ramp ref")["choices"][0]["text"] \
+            == reference
+
+        # tpu_autoscale_* renders on BOTH /metrics routes (R12 contract)
+        with urllib.request.urlopen(rurl + "/metrics", timeout=10) as r:
+            assert "tpu_autoscale_desired_replicas" in r.read().decode()
+        survivor = next(h.addr for h in a._replicas.values()
+                        if h.state == autoscaler.SERVING)
+        with urllib.request.urlopen(f"http://{survivor}/metrics",
+                                    timeout=10) as r:
+            assert "tpu_autoscale_desired_replicas" in r.read().decode()
+
+        # the decision journal reached the flight recorder
+        _harvest_decisions(decisions)
+        assert "scale_up" in decisions and "drain" in decisions, decisions
+
+        # /debug/autoscale + /debug/fleet expose the controller
+        with urllib.request.urlopen(rurl + "/debug/autoscale",
+                                    timeout=10) as r:
+            dbg = json.loads(r.read())
+        assert dbg["enabled"] is True and dbg["actual"] == 1
+        with urllib.request.urlopen(rurl + "/debug/fleet", timeout=10) as r:
+            assert "autoscale" in json.loads(r.read())
+    finally:
+        a.stop()
+        poll_stop.set()
+        router.shutdown()
+        for stop in list(stops.values()):
+            stop.set()
+
+
+@pytest.mark.slow
+def test_scale_to_zero_cold_start_serves_first_request(model):
+    """Scale-to-zero end-to-end: an idle two-replica fleet drains to
+    parked (the pool goes empty — static seeds stay gone once removed),
+    and the FIRST request through the router triggers a cold start that
+    answers within the ready-time budget + headroom."""
+    stops: dict = {}
+
+    def spawn():
+        addr, _, _ = _start_replica(model, next(_PORTS), stops)
+        return addr, stops[addr]
+
+    s1, ready1, _ = _start_replica(model, next(_PORTS), stops)
+    s2, ready2, _ = _start_replica(model, next(_PORTS), stops)
+    assert ready1.wait(120) and ready2.wait(120)
+    # comma-list pool: the static layer FORGETS removed seeds (the
+    # single host:port form is DNS-backed and always re-resolves)
+    pool = BackendPool(f"{s1},{s2}", cooldown_s=5.0)
+    router, rurl, poll_stop = _start_router(pool)
+
+    a = autoscaler.configure(
+        enabled=True, min_replicas=0, max_replicas=2, interval_s=0.25,
+        stable_s=0.5, cooldown_s=1.0, standby=0, idle_timeout_s=2.0,
+        ready_timeout_s=120.0)
+    a.install(pool=pool,
+              launcher=CallableLauncher(spawn, lambda ad, s: s.set()))
+    a.adopt(s1)
+    a.adopt(s2)
+    a.start()
+    try:
+        # idle past the timeout: the fleet drains to parked, pool empty
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            st = a.status()
+            if st["parked"] and st["actual"] == 0 and st["draining"] == 0:
+                break
+            time.sleep(0.25)
+        st = a.status()
+        assert st["parked"] and st["actual"] == 0, st
+        assert pool.pick() == [], pool.addrs()
+
+        # first request cold-starts the fleet: ready-time + headroom
+        decisions: set = set()
+        done = []
+        th = threading.Thread(target=lambda: done.append(
+            _post_completion(rurl, "wake up", timeout=120)))
+        t0 = time.monotonic()
+        th.start()
+        while th.is_alive() and time.monotonic() - t0 < 120:
+            _harvest_decisions(decisions)
+            time.sleep(0.2)
+        th.join(timeout=5)
+        cold_s = time.monotonic() - t0
+        assert done and done[0]["choices"][0]["text"], done
+        assert cold_s < 60.0, f"cold start took {cold_s:.1f}s"
+        st = a.status()
+        assert st["cold_starts"] == 1 and st["actual"] >= 1
+        _harvest_decisions(decisions)
+        assert "cold_start" in decisions, decisions
+    finally:
+        a.stop()
+        poll_stop.set()
+        router.shutdown()
+        for stop in list(stops.values()):
+            stop.set()

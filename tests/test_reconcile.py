@@ -164,6 +164,64 @@ def test_probe_l3_slo_detail(monkeypatch):
         srv.shutdown()
 
 
+class _FakeAutoscaleRouter(BaseHTTPRequestHandler):
+    """Router stub serving only /debug/autoscale."""
+    status: dict = {}
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        body = json.dumps(type(self).status).encode()
+        self.send_response(200 if self.path == "/debug/autoscale" else 404)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_probe_l3_autoscale_detail(monkeypatch):
+    """Autoscale satellite: L3 reads the router's /debug/autoscale into a
+    NON-REPAIRING `autoscale: ok|scaling(n→m)|stuck` detail — a fleet
+    mid-scale is the controller working, and even a stuck drain is the
+    controller's to escalate; the probe never repairs. TPU_PROBE_AUTOSCALE
+    points at the router; '0'/'off' disables the leg."""
+    rep = ThreadingHTTPServer(("127.0.0.1", 0), _FakeReplica)
+    rtr = ThreadingHTTPServer(("127.0.0.1", 0), _FakeAutoscaleRouter)
+    for s in (rep, rtr):
+        threading.Thread(target=s.serve_forever, daemon=True).start()
+    try:
+        _FakeReplica.draining = False
+        monkeypatch.setenv("TPU_PROBE_REPLICAS",
+                           f"127.0.0.1:{rep.server_port}")
+        monkeypatch.setenv("TPU_PROBE_AUTOSCALE",
+                           f"127.0.0.1:{rtr.server_port}")
+        base = {"enabled": True, "desired": 2, "actual": 2,
+                "launching": 0, "draining": 0, "stuck": 0}
+        _FakeAutoscaleRouter.status = dict(base)
+        r = probes.probe_l3({}, None)
+        assert r.ok and "autoscale: ok" in r.detail
+        # desired != actual -> scaling(n→m), still ok (non-repairing)
+        _FakeAutoscaleRouter.status = dict(base, desired=4, launching=2)
+        r = probes.probe_l3({}, None)
+        assert r.ok and "autoscale: scaling(2→4)" in r.detail
+        # a wedged drain surfaces as stuck, probe STAYS ok
+        _FakeAutoscaleRouter.status = dict(base, draining=1, stuck=1)
+        r = probes.probe_l3({}, None)
+        assert r.ok and "autoscale: stuck" in r.detail
+        # 'off' disables the leg entirely
+        monkeypatch.setenv("TPU_PROBE_AUTOSCALE", "off")
+        r = probes.probe_l3({}, None)
+        assert r.ok and "autoscale" not in r.detail
+        # controller disabled on the router: leg silently skipped
+        monkeypatch.setenv("TPU_PROBE_AUTOSCALE",
+                           f"127.0.0.1:{rtr.server_port}")
+        _FakeAutoscaleRouter.status = {"enabled": False}
+        assert "autoscale" not in probes.probe_l3({}, None).detail
+    finally:
+        rep.shutdown()
+        rtr.shutdown()
+
+
 def test_probe_l5_override(monkeypatch):
     monkeypatch.setenv("TPU_PROBE_COLLECTOR", "http://127.0.0.1:1/healthz")
     assert not probes.probe_l5({}, None).ok

@@ -803,6 +803,114 @@ def test_r11_silent_when_no_capacity_metrics_exist(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# R12: tpu_autoscale_* both-route rendering + single writer in its module
+# ---------------------------------------------------------------------------
+
+
+_R12_BASE = {
+    "pkg/serving/autoscaler.py": """
+        class AutoscaleMetrics:
+            def __init__(self):
+                r = Registry()
+                self.registry = r
+                self.desired_replicas = r.register(
+                    Gauge("tpu_autoscale_desired_replicas", "target"))
+                self.actual_replicas = r.register(
+                    Gauge("tpu_autoscale_actual_replicas", "serving"))
+
+        metrics = AutoscaleMetrics()
+
+        class Autoscaler:
+            def export(self):
+                metrics.desired_replicas.set(3)
+                metrics.actual_replicas.set(2)
+    """,
+    "pkg/serving/server.py": """
+        class Handler:
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = autoscaler.metrics.registry.render()
+    """,
+    "pkg/serving/router.py": """
+        class RHandler:
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = autoscaler.metrics.registry.render()
+    """,
+}
+
+
+def test_r12_clean_when_both_routes_render_and_one_writer(tmp_path):
+    assert _lint(tmp_path, _R12_BASE, only=["R12"]) == []
+
+
+def test_r12_fires_when_router_route_misses_autoscale_set(tmp_path):
+    files = dict(_R12_BASE)
+    files["pkg/serving/router.py"] = """
+        class RHandler:
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = own.metrics.registry.render()
+    """
+    fs = _lint(tmp_path, files, only=["R12"])
+    assert _rules_of(fs) == ["R12"]
+    assert "router" in fs[0].message and "AutoscaleMetrics" in fs[0].message
+
+
+def test_r12_fires_on_second_writer_site(tmp_path):
+    """A decision site poking a gauge directly (Counter.inc at the
+    scale-up branch) would make the scrape depend on which code path
+    last ran — only the export step may write."""
+    files = dict(_R12_BASE)
+    files["pkg/serving/engine.py"] = """
+        class Engine:
+            def step(self):
+                autoscaler.metrics.desired_replicas.set(9)
+    """
+    fs = _lint(tmp_path, files, only=["R12"])
+    assert _rules_of(fs) == ["R12"]
+    assert "'desired_replicas'" in fs[0].message and "2 sites" in fs[0].message
+
+
+def test_r12_fires_when_single_writer_lives_outside_autoscaler_module(
+        tmp_path):
+    files = dict(_R12_BASE)
+    files["pkg/serving/autoscaler.py"] = """
+        class AutoscaleMetrics:
+            def __init__(self):
+                r = Registry()
+                self.registry = r
+                self.desired_replicas = r.register(
+                    Gauge("tpu_autoscale_desired_replicas", "target"))
+
+        metrics = AutoscaleMetrics()
+    """
+    files["pkg/serving/server.py"] = """
+        class Handler:
+            def do_GET(self):
+                if self.path == "/metrics":
+                    autoscaler.metrics.desired_replicas.set(1)
+                    body = autoscaler.metrics.registry.render()
+    """
+    fs = _lint(tmp_path, files, only=["R12"])
+    assert _rules_of(fs) == ["R12"]
+    assert "serving/server.py" in fs[0].message \
+        and "serving/autoscaler.py" in fs[0].message
+
+
+def test_r12_silent_when_no_autoscale_metrics_exist(tmp_path):
+    fs = _lint(tmp_path, {"pkg/serving/metrics.py": """
+        class EngineMetrics:
+            def __init__(self):
+                r = Registry()
+                self.registry = r
+                self.requests = r.register(
+                    Counter("tpu_serve_requests_total", "n"))
+    """}, only=["R12"])
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
 # runner semantics
 # ---------------------------------------------------------------------------
 

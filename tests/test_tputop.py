@@ -176,6 +176,44 @@ def test_render_capacity_panel_golden_frame():
     assert " :=+#" in row_a
 
 
+def test_render_autoscale_panel_golden_frame():
+    """The autoscale line renders exactly from /debug/fleet's ``autoscale``
+    status block: desired vs actual, in-flight transitions, and the last
+    decision with its age. Absent or disabled controller -> no line."""
+    fleet = {
+        "backends": ["a:1"], "cooling_down": [], "draining": [],
+        "replicas": {"a:1": {"cooling": False, "draining": False,
+                             "health": _healthy()}},
+        "autoscale": {
+            "enabled": True, "desired": 3, "actual": 2, "launching": 1,
+            "standby": 1, "draining": 0, "stuck": 0, "parked": False,
+            "last_decision": "scale_up", "last_decision_age_s": 4.2,
+        },
+    }
+    lines = tputop.render(fleet).splitlines()
+    assert lines[1] == ("autoscale: desired 3 / actual 2 "
+                        "(1 launching, 1 standby), last scale_up 4s ago")
+    # a wedged drain and a parked fleet both surface in the same line
+    fleet["autoscale"].update({"desired": 0, "actual": 0, "launching": 0,
+                               "standby": 0, "draining": 1, "stuck": 1,
+                               "parked": True, "last_decision": "drain_stuck",
+                               "last_decision_age_s": 61.0})
+    lines = tputop.render(fleet).splitlines()
+    assert lines[1] == ("autoscale: desired 0 / actual 0 "
+                        "(1 draining, 1 stuck, parked), "
+                        "last drain_stuck 61s ago")
+    # no decision yet (age -1.0 sentinel) -> no trailing age
+    fleet["autoscale"] = {"enabled": True, "desired": 1, "actual": 1,
+                          "last_decision": None,
+                          "last_decision_age_s": -1.0}
+    assert tputop.render(fleet).splitlines()[1] == \
+        "autoscale: desired 1 / actual 1"
+    # disabled controller: the panel line disappears entirely
+    fleet["autoscale"]["enabled"] = False
+    assert not any(ln.startswith("autoscale:")
+                   for ln in tputop.render(fleet).splitlines())
+
+
 def test_render_pipeline_drain_column():
     """The ``drain`` column renders the /healthz pipeline block's drain
     rate (drains per dispatch — ~0 on the ragged mixed path); a replica

@@ -41,6 +41,9 @@ LOWER_BETTER = frozenset({
     "bubble_ms_per_step", "cold_ready_s", "warm_ready_s", "aot_ready_s",
     "dispatch_rtt_ms", "failover_first_success_ms", "latency_p50_ms",
     "latency_p95_ms", "shed_rate", "ragged_edge_drains",
+    # autoscale ramp (AUTOSCALE_BENCH.json "ramp" block): reaction time,
+    # worst shed while the fleet caught up, non-429 failures during drain
+    "time_to_first_scale_up_s", "peak_shed_rate", "drain_errors",
 })
 
 

@@ -1020,3 +1020,104 @@ def r11_capacity_metrics(project: Project) -> List[Finding]:
                 f"{cap_attrs[attr]} — the single writer must be that "
                 "module's export step (drop-not-fail guard included)"))
     return out
+
+
+# ---------------------------------------------------------------------------
+# R12: tpu_autoscale_* signals — both-route rendering + single-writer export
+# ---------------------------------------------------------------------------
+
+
+@rule("R12", "tpu_autoscale_* rendered on both /metrics routes, one writer")
+def r12_autoscale_metrics(project: Project) -> List[Finding]:
+    """The fleet-actuation plane (serving/autoscaler.py) closes the loop
+    that R11's capacity signals open: its gauges record what the
+    controller actually DID (desired vs actual replicas, drains, launch
+    failures, suppressed flaps).  An operator diffing the router scrape
+    against an engine scrape during an incident must see the same
+    actuation story, and a gauge written from two code paths can tell
+    two different ones:
+
+    1. every metric set registering a ``tpu_autoscale_*`` name must be
+       rendered by BOTH the engine server's and the router's ``/metrics``
+       routes;
+    2. each ``tpu_autoscale_*`` metric attribute may be WRITTEN
+       (``inc/set/add/observe`` through a ``*.metrics.<attr>`` chain)
+       from at most one function across serving/ — the whole actuation
+       set is one consistent snapshot derived in one export step
+       (``Autoscaler.export()``), never updated piecemeal from decision
+       sites;
+    3. that single writer site must live in the file that DEFINES the
+       autoscale metric set — a route handler poking an autoscale gauge
+       inline splits the snapshot across modules and silently bypasses
+       the drop-not-fail export guard.
+
+    Same resolution approximations as R2/R10/R11 (``_resolve_owner``);
+    writer sites are keyed by (file, enclosing function)."""
+    out: List[Finding] = []
+    classes = _collect_metric_classes(project)
+    asc_classes = {
+        name: mc for name, mc in classes.items()
+        if any(n.startswith("tpu_autoscale_") for n in mc.attrs.values())}
+    if not asc_classes:
+        return out
+
+    # (1) both routes must render every autoscale metric set
+    server = project.get("serving/server.py")
+    router = project.get("serving/router.py")
+    if server is not None and router is not None:
+        server_owned = {_resolve_owner(c, server, project, classes)
+                        for c in _render_owners(server)}
+        router_owned = {_resolve_owner(c, router, project, classes)
+                        for c in _render_owners(router)}
+        for mc in sorted(asc_classes.values(), key=lambda m: m.name):
+            missing = [r for r, owned in (("server", server_owned),
+                                          ("router", router_owned))
+                       if mc.name not in owned]
+            if missing:
+                out.append(Finding(
+                    "R12", mc.file.rel, mc.lineno,
+                    f"autoscale metric set {mc.name} (tpu_autoscale_* "
+                    f"names) is not rendered by the {' and '.join(missing)} "
+                    "/metrics route(s) — the fleet scrape and the replica "
+                    "scrape must tell the same actuation story"))
+
+    # (2)+(3) exactly one writer site, in the defining file
+    asc_attrs = {attr: mc.file.rel
+                 for mc in asc_classes.values()
+                 for attr, n in mc.attrs.items()
+                 if n.startswith("tpu_autoscale_")}
+    writers: Dict[str, List[Tuple[str, str, int]]] = {}
+    for f in project.serving_files():
+        for node, ancestors in _walk_with_stack(f.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_OPS):
+                continue
+            chain = attr_chain(node.func.value)
+            if (len(chain) < 2 or chain[-2] != "metrics"
+                    or chain[-1] not in asc_attrs):
+                continue
+            encl = _enclosing_funcdef(ancestors)
+            writers.setdefault(chain[-1], []).append(
+                (f.rel, encl.name if encl else "<module>", node.lineno))
+    for attr in sorted(writers):
+        sites = sorted({(path, fn) for path, fn, _ in writers[attr]})
+        if len(sites) > 1:
+            path, fn, lineno = max(writers[attr], key=lambda s: (s[0], s[2]))
+            others = ", ".join(f"{p}:{f}" for p, f in sites)
+            out.append(Finding(
+                "R12", path, lineno,
+                f"autoscale metric attribute '{attr}' is written from "
+                f"{len(sites)} sites ({others}) — tpu_autoscale_* signals "
+                "must have exactly one writer (the autoscaler export "
+                "step) so a scrape is one consistent snapshot"))
+            continue
+        path, fn, lineno = writers[attr][0]
+        if path != asc_attrs[attr]:
+            out.append(Finding(
+                "R12", path, lineno,
+                f"autoscale metric attribute '{attr}' is written from "
+                f"{path}:{fn} but its metric set is defined in "
+                f"{asc_attrs[attr]} — the single writer must be that "
+                "module's export step (drop-not-fail guard included)"))
+    return out

@@ -183,6 +183,29 @@ def _row(addr: str, ent: dict, hist=None) -> list:
             anomaly]
 
 
+def _autoscale_line(asc: dict) -> str:
+    """One panel line from /debug/fleet's ``autoscale`` status dict: the
+    desired-vs-actual gap, in-flight transitions, and the controller's
+    last decision with its age — the three things an operator checks
+    first when the fleet size looks wrong."""
+    line = f"autoscale: desired {asc.get('desired', '-')}" \
+           f" / actual {asc.get('actual', '-')}"
+    extras = [f"{asc.get(k) or 0} {k}"
+              for k in ("launching", "standby", "draining", "stuck")
+              if asc.get(k)]
+    if asc.get("parked"):
+        extras.append("parked")
+    if extras:
+        line += " (" + ", ".join(extras) + ")"
+    last = asc.get("last_decision")
+    if last:
+        age = asc.get("last_decision_age_s")
+        line += f", last {last}"
+        if isinstance(age, (int, float)) and age >= 0:
+            line += f" {age:.0f}s ago"
+    return line
+
+
 def render(fleet: dict, caphist: dict | None = None) -> str:
     """One dashboard frame from a /debug/fleet dict — pure, testable.
     ``caphist`` maps replica addr -> recent utilization samples (the watch
@@ -206,6 +229,9 @@ def render(fleet: dict, caphist: dict | None = None) -> str:
         head += f", {len(fleet['cooling_down'])} cooling"
     head += f", SLO {'BURNING: ' + ', '.join(burning) if burning else 'ok'}"
     lines.append(head)
+    asc = fleet.get("autoscale")
+    if asc and asc.get("enabled"):
+        lines.append(_autoscale_line(asc))
     lines.append(sep.join(c.ljust(w) for c, w in zip(COLUMNS, widths)))
     for r in rows:
         lines.append(sep.join(str(v).ljust(w) for v, w in zip(r, widths)))
