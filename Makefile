@@ -7,7 +7,8 @@ PY ?= python
 .PHONY: test bench-smoke bench-dry ttft-sweep chaos-smoke validate-manifests \
 	overload-smoke resume-smoke reconcile-smoke trace-smoke lint \
 	locksan-smoke aot-smoke pipeline-smoke ragged-smoke flight-smoke \
-	devmon-smoke capacity-smoke bench-diff bench-ragged autoscale-smoke
+	devmon-smoke capacity-smoke bench-diff bench-ragged bench-mixedfeat \
+	autoscale-smoke
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -142,6 +143,12 @@ ragged-smoke:
 # and writes BENCH_ragged_r01.json.
 bench-ragged:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --ragged
+
+# Feature-vs-plain A/B on the ragged pipeline (ISSUE 16): spec + guided +
+# LoRA + chunked prefill concurrently must hold >= 0.9x plain tok/s with
+# zero feature-reason pipeline drains. Writes BENCH_mixedfeat_r01.json.
+bench-mixedfeat:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --mixed-features
 
 # AOT registry smoke (serving/aot.py): deviceless host-platform compile of
 # the full tiny-config program set through build_manifest — manifest schema

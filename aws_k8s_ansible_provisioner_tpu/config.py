@@ -618,11 +618,21 @@ class ServingConfig:
     # alongside every decode row against the paged pool), so admissions no
     # longer drain the one-deep pipeline and the chunk/decode alternation
     # disappears. Requires paged + decode_pipeline; auto-falls-back to the
-    # legacy serialized chunk path for spec decode, LoRA, guided slots,
-    # dp/sp meshes, or a draining engine. 0 restores the legacy path
-    # everywhere (sync escape hatch; seeded streams are byte-identical
-    # either way).
+    # legacy serialized chunk path for dp/sp meshes or a draining engine
+    # (and, with ragged_features=0, for spec decode / LoRA / guided slots).
+    # 0 restores the legacy path everywhere (sync escape hatch; seeded
+    # streams are byte-identical either way).
     ragged_attention: int = 1
+    # Feature paths ride the ragged pipeline (the "fallback tax" fix):
+    # guided decoding carries its FSM mask as a device-resident per-row
+    # logit-mask operand (uploaded one step ahead — no blocking host read),
+    # LoRA rows select packed A/B deltas via a per-token adapter-index
+    # operand inside the packed [1, B+C] layout, and spec-decode verify
+    # hands the device carry off settle-style instead of draining the
+    # pipeline. 0 restores the PR-14 gating (spec/LoRA/guided de-pipeline
+    # to the sync floor) — the byte-identity A/B fallback arm; seeded
+    # streams are byte-identical either way.
+    ragged_features: int = 1
     # Paged KV cache geometry.
     page_size: int = 64
     # True paged KV (vLLM's on-demand block allocation; serving/paged_kv.py):

@@ -328,13 +328,24 @@ def _linear(x, p):
     if "lora_A" in p:
         idx = getattr(_LORA, "idx", None)
         if idx is not None:
-            # per-row low-rank delta: gather each row's adapter factors
-            # (index 0 is the all-zero base adapter) and fold x@A@B in —
-            # O(B·T·r·(din+dout)) beside the base matmul
-            A = p["lora_A"][idx].astype(x.dtype)       # [B, din, r]
-            Bm = p["lora_B"][idx].astype(x.dtype)      # [B, r, dout]
-            delta = jnp.einsum("b...r,bro->b...o",
-                               jnp.einsum("b...i,bir->b...r", x, A), Bm)
+            if idx.ndim == x.ndim - 1:
+                # Per-TOKEN adapter indices ([B, T] against x [B, T, H]):
+                # the ragged mixed layout packs every slot's decode row plus
+                # the chunk rows into one [1, B+C] sequence, so rows of the
+                # same "batch" belong to different adapters. Gather factors
+                # per token and contract with token-local einsums.
+                A = p["lora_A"][idx].astype(x.dtype)   # [B, T, din, r]
+                Bm = p["lora_B"][idx].astype(x.dtype)  # [B, T, r, dout]
+                delta = jnp.einsum("btr,btro->bto",
+                                   jnp.einsum("bti,btir->btr", x, A), Bm)
+            else:
+                # per-row low-rank delta: gather each row's adapter factors
+                # (index 0 is the all-zero base adapter) and fold x@A@B in —
+                # O(B·T·r·(din+dout)) beside the base matmul
+                A = p["lora_A"][idx].astype(x.dtype)       # [B, din, r]
+                Bm = p["lora_B"][idx].astype(x.dtype)      # [B, r, dout]
+                delta = jnp.einsum("b...r,bro->b...o",
+                                   jnp.einsum("b...i,bir->b...r", x, A), Bm)
             y = y + delta.astype(y.dtype)
     if "bias" in p:
         y = y + p["bias"]

@@ -66,6 +66,24 @@ def apply_penalties(logits: jnp.ndarray, counts: jnp.ndarray,
             - presence[:, None] * (c > 0))
 
 
+def apply_allow(logits: jnp.ndarray, allow: jnp.ndarray) -> jnp.ndarray:
+    """Grammar allow-mask: keep only tokens whose bit is set per row.
+
+    logits: [B, V]; allow: [B, ceil(V/32)] uint32 bitset (bit t of word
+    t >> 5 = token t allowed). A row of all-ones words is an exact no-op, so
+    unguided slots ride the same compiled program as guided ones — the mask
+    is a per-row OPERAND, not a program variant. Applied after bias/ban and
+    before sampling; masked logits go to -inf, which the token-id-keyed
+    Gumbel in :func:`sample` tolerates without perturbing other tokens'
+    draws (the byte-identity contract for guided streams).
+    """
+    V = logits.shape[-1]
+    idx = jnp.arange(V, dtype=jnp.int32)
+    bits = (allow[:, idx >> 5] >> (idx & 31).astype(jnp.uint32)) \
+        & jnp.uint32(1)
+    return jnp.where(bits.astype(bool), logits, -jnp.inf)
+
+
 def sample(
     logits: jnp.ndarray,       # [B, V] float
     rng: jax.Array,            # one key for the batch, OR [B] per-slot keys

@@ -317,22 +317,38 @@ def enumerate_programs(plan, mesh, params, cache, bblock: int = 1):
     programs.append((f"decode_fused_h{plan.horizon}_logprobs", decode_steps,
                      decode_args, decode_kwargs(logprobs=True)))
     if (plan.paged and serving.ragged_attention > 0
-            and serving.decode_pipeline > 0 and not serving.spec_decode):
+            and serving.decode_pipeline > 0
+            and (serving.ragged_features > 0 or not serving.spec_decode)):
         # Ragged mixed-batch program (ISSUE 14): one dispatch serves a
         # prefill chunk packed alongside every decode row. Operand layout
-        # mirrors EnginePrograms._mixed_dispatch exactly.
-        programs.append((
-            f"mixed_c{plan.chunk}", mixed_step,
-            (cfg, params, cache, sds((B,), i32), sds((B,), i32),
-             sds((1, plan.chunk), i32), scalar, scalar, scalar,
-             sds((), f32), sds((cfg.vocab_size,), jnp.bool_),
-             sds((), u32), sds((), f32), scalar, sds((), f32), rng,
-             sds((B,), f32), sds((B,), i32), sds((B,), f32)),
-            dict(mesh=mesh, impl=serving.attention_impl,
-                 table=sds((B, pps), i32), seeds=sds((B,), u32),
-                 ban_ids=sds((B, BAN_K), i32), ban_until=sds((B,), i32),
-                 bias_ids=sds((B, BIAS_K), i32),
-                 bias_vals=sds((B, BIAS_K), f32), bblock=bblock)))
+        # mirrors EnginePrograms._mixed_dispatch exactly. With
+        # ragged_features (ISSUE 16) the spec-decode clause relaxes —
+        # verify now hands the carry off instead of forcing a pre-spec
+        # drain, so a spec-enabled engine still runs the mixed program.
+        mixed_args = (cfg, params, cache, sds((B,), i32), sds((B,), i32),
+                      sds((1, plan.chunk), i32), scalar, scalar, scalar,
+                      sds((), f32), sds((cfg.vocab_size,), jnp.bool_),
+                      sds((), u32), sds((), f32), scalar, sds((), f32), rng,
+                      sds((B,), f32), sds((B,), i32), sds((B,), f32))
+        mixed_kwargs = dict(
+            mesh=mesh, impl=serving.attention_impl,
+            table=sds((B, pps), i32), seeds=sds((B,), u32),
+            ban_ids=sds((B, BAN_K), i32), ban_until=sds((B,), i32),
+            bias_ids=sds((B, BIAS_K), i32),
+            bias_vals=sds((B, BIAS_K), f32), bblock=bblock)
+        programs.append((f"mixed_c{plan.chunk}", mixed_step,
+                         mixed_args, mixed_kwargs))
+        if serving.ragged_features > 0:
+            # Guided variant (ISSUE 16): decode-row allow bitset + the
+            # chunking request's own grammar row — the per-row mask
+            # operands _mixed_dispatch passes when any guided slot is
+            # active. Proven once here so the first guided admission on a
+            # manifest-adopted replica never compiles.
+            W = (cfg.vocab_size + 31) // 32
+            programs.append((
+                f"mixed_c{plan.chunk}_guided", mixed_step, mixed_args,
+                dict(mixed_kwargs, allow=sds((B, W), u32),
+                     pallow=sds((1, W), u32))))
     if plan.spec_rows:
         R = plan.spec_rows
         programs.append((

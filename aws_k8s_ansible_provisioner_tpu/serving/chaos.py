@@ -44,6 +44,16 @@ fault                       defined degradation behavior
                             through the normal teardown (slots/pages
                             released exactly once) and the engine keeps
                             serving
+``ragged_feature_error``    a FEATURE operand of a ragged dispatch fails —
+                            the guided-mask device upload surfaces its error
+                            at the deferred fetch (``kind=guided``), or a
+                            spec-decode verify row is corrupted at its
+                            synchronous read (``kind=spec``). Either way the
+                            dispatch is discarded with nothing emitted, its
+                            requests fail with "error" through the normal
+                            teardown (slots/pages released exactly once) and
+                            the engine keeps serving — the feature paths
+                            inherit the pipeline's failure contract
 ``span_export``             the OTLP trace collector misbehaves — refuses
                             connections, hangs, or answers 5xx (``mode``) —
                             only the exporter's background thread sees it:
@@ -113,7 +123,8 @@ from typing import Dict, Optional
 FAULTS = ("connect_refused", "stalled_decode", "page_exhaustion",
           "slow_client", "mid_stream_disconnect", "kill_stream",
           "stream_read_error", "span_export", "pipeline_fetch_error",
-          "ragged_dispatch_error", "flight_dump_error",
+          "ragged_dispatch_error", "ragged_feature_error",
+          "flight_dump_error",
           "capacity_export_error", "autoscale_launch_error",
           "autoscale_drain_stuck")
 
@@ -266,6 +277,31 @@ class ChaosController:
             return
         raise InjectedFault(
             "chaos: injected ragged mixed-dispatch failure")
+
+    def on_feature_path(self, engine, kind: str) -> None:
+        """Feature-operand fault sites of the ragged pipeline (ISSUE 16):
+        ``kind="guided"`` fires at the deferred fetch of a dispatch that
+        carried a grammar allow-mask operand (the one-step-ahead async
+        upload surfacing a transfer error at its block point);
+        ``kind="spec"`` fires at the synchronous read of a spec-decode
+        verify result (a corrupted verify row). An armed
+        ``ragged_feature_error`` raises InjectedFault — step() unwinds,
+        run_forever's catch-all discards the in-flight record un-emitted
+        and fails the affected requests with "error" (slots/pages released
+        exactly once), and the engine keeps serving. ``kind=...`` in the
+        fault params restricts firing to one feature path; trigger counting
+        only consumes on matching sites, so after/times stay deterministic
+        per path."""
+        p = self.active("ragged_feature_error")
+        if p is None:
+            return
+        want = p.get("kind")
+        if want and str(want) != kind:
+            return
+        if self.fire("ragged_feature_error") is None:
+            return
+        raise InjectedFault(
+            f"chaos: injected ragged feature-path failure ({kind})")
 
     def on_engine_step(self, engine) -> None:
         """engine.step entry: an armed ``page_exhaustion`` makes the page

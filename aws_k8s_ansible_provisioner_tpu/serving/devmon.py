@@ -109,11 +109,18 @@ class CostModel:
     ``kv_row_bytes``     — k+v bytes for ONE token of context across all
                            layers/heads (int8 rows include their f32 scale,
                            mirroring kv_cache.py's accounting).
+    ``mask_row_bytes``   — bytes of ONE row of the guided-decoding allow
+                           bitset (ceil(V/32) uint32 words): the per-step
+                           host→HBM upload a guided row adds when it rides
+                           the ragged pipeline (ISSUE 16). Tiny next to
+                           weights — the point of attributing it is proving
+                           that, not worrying about it.
     """
 
     flops_per_token: float
     weight_bytes: float
     kv_row_bytes: float
+    mask_row_bytes: float = 0.0
 
     @staticmethod
     def from_config(cfg, kv_dtype: str = "bf16",
@@ -133,12 +140,14 @@ class CostModel:
         else:
             per_head_row = cfg.head_dim * 2       # bf16
         kv_row = cfg.num_layers * 2 * cfg.num_kv_heads * per_head_row
+        mask_row = float(-(-cfg.vocab_size // 32) * 4)   # ceil(V/32) u32 words
         return CostModel(flops_per_token=2.0 * matmul_params,
                          weight_bytes=float(weight_bytes),
-                         kv_row_bytes=float(kv_row))
+                         kv_row_bytes=float(kv_row),
+                         mask_row_bytes=mask_row)
 
     def cost(self, kind: str, batch: int, tokens: int, ctx_rows: float,
-             steps: int) -> Tuple[float, float]:
+             steps: int, guided_rows: int = 0) -> Tuple[float, float]:
         """(flops, hbm_bytes) for one dispatch.
 
         decode-like: weights stream once per STEP (shared by the batch);
@@ -150,17 +159,21 @@ class CostModel:
         if kind == "prefix_copy":
             return 0.0, 2.0 * tokens * self.kv_row_bytes
         flops = self.flops_per_token * tokens
+        # Guided rows upload one allow-bitset row per step (the one-ahead
+        # async upload ISSUE 16 added); pure extra HBM traffic, zero flops.
+        mask = guided_rows * steps * self.mask_row_bytes
         if kind == "mixed_step":
             # ragged mixed batch: weights stream once for BOTH the decode
             # rows and the packed prefill chunk (the fusion's bandwidth
             # win); decode rows read their context, chunk rows write theirs
             return flops, (self.weight_bytes
-                           + tokens * ctx_rows * self.kv_row_bytes)
+                           + tokens * ctx_rows * self.kv_row_bytes + mask)
         if kind in ("decode", "spec_decode"):
             byts = steps * self.weight_bytes \
-                + tokens * ctx_rows * self.kv_row_bytes
+                + tokens * ctx_rows * self.kv_row_bytes + mask
         else:
-            byts = steps * self.weight_bytes + tokens * self.kv_row_bytes
+            byts = steps * self.weight_bytes + tokens * self.kv_row_bytes \
+                + mask
         return flops, byts
 
 
@@ -208,17 +221,21 @@ class DevMon:
     # -- recording (engine thread; drop-not-fail, never blocks on device) ---
 
     def note(self, kind: str, device_s: float, batch: int = 1,
-             tokens: int = 1, ctx_rows: float = 0.0, steps: int = 1):
+             tokens: int = 1, ctx_rows: float = 0.0, steps: int = 1,
+             guided_rows: int = 0):
         """Record one settled dispatch. Called ONLY after the engine has
         already synced the dispatch (the _decode_fetch side of the
-        pipeline) — never adds a device read to the dispatch path (R8)."""
+        pipeline) — never adds a device read to the dispatch path (R8).
+        ``guided_rows`` = decode rows carrying a grammar allow-mask operand
+        (each adds one mask_row_bytes upload per step to the byte model)."""
         if not self.enabled or kind not in self._acc:
             return
         cm = self.cost_model
         if cm is None:
             flops, byts = 0.0, 0.0
         else:
-            flops, byts = cm.cost(kind, batch, tokens, ctx_rows, steps)
+            flops, byts = cm.cost(kind, batch, tokens, ctx_rows, steps,
+                                  guided_rows=guided_rows)
         now = self.clock()
         with self._lock:
             dq = self._acc[kind]

@@ -101,9 +101,22 @@ class DraftModel:
            the draft via one R-wide argmax dispatch; they propose NEXT round.
         2. rollout: one fused greedy ``decode_steps`` over the whole slot
            axis proposes K tokens for every up-to-date slot.
+
+        Carry-generation handoff contract (ISSUE 16): the engine reaches a
+        spec round by SETTLING any in-flight pipelined dispatch (fetch +
+        emit, no drain) rather than draining it, so by the time propose()
+        reads the host mirrors (``engine.lengths``, ``engine.last_token``)
+        they are exact — lazily synced, never stale. The assert makes a
+        violated handoff fail loudly at the proposal site instead of as a
+        silent off-by-one in the draft cache.
         """
         from aws_k8s_ansible_provisioner_tpu.serving.engine import (
             decode_steps, spec_decode_step)
+
+        assert getattr(engine, "_inflight", None) is None, (
+            "draft.propose() with a dispatch still in flight — the engine "
+            "must settle the pipeline before a spec round (host mirrors "
+            "would be stale)")
 
         R = K + 1
         gaps = {s: int(engine.lengths[s]) - int(self.lens[s])

@@ -246,7 +246,7 @@ class Engine(EnginePrograms):
         "_tok_times", "_admit_seq", "_seq_counter", "prompt_mask",
         "_inflight", "_pipe_carry", "_carry_gen", "_op_cache",
         "_op_dirty_sampling", "_op_dirty_table", "_last_ready",
-        "_busy_watermark",
+        "_busy_watermark", "_allow_dev", "_allow_batch_dev",
     )
 
     def __init__(self, cfg: ModelConfig, params, serving: ServingConfig,
@@ -413,6 +413,14 @@ class Engine(EnginePrograms):
         self._op_cache: dict = {}
         self._op_dirty_sampling = True
         self._op_dirty_table = True
+        # Guided allow-mask device caches (ISSUE 16): one-entry
+        # (key, device array) pairs keyed on FSM fingerprints, so a mask
+        # whose grammar state did not advance between dispatches (a guided
+        # chunk walk, decode steps around a neighbor's admission) is
+        # re-dispatched without a rebuild or re-upload
+        # (EnginePrograms._allow_row / _allow_words).
+        self._allow_dev = None
+        self._allow_batch_dev = None
         # Bubble accounting: _last_ready marks a fetch completing with
         # nothing enqueued behind it (device going idle); the next dispatch
         # books the gap on decode_bubble_seconds. _busy_watermark is the

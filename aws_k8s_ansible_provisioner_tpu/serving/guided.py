@@ -980,6 +980,15 @@ class GuidedState:
         else:
             self.state = nxt
 
+    def fingerprint(self):
+        """Hashable identity of the current mask: two cursors with equal
+        fingerprints produce bit-identical ``mask_words()`` (machine states
+        are the TokenGrammar mask cache's own keys). The engine's
+        device-mask caches (EnginePrograms._allow_row/_allow_words) key on
+        this to skip rebuilding + re-uploading an allow operand whose FSM
+        did not advance between dispatches."""
+        return (self.state, self.dead)
+
     @property
     def complete(self) -> bool:
         return (not self.dead) and self.grammar.accepting(self.state)

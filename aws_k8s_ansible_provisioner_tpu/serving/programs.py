@@ -50,7 +50,8 @@ from aws_k8s_ansible_provisioner_tpu.ops.attention import (
     make_spec_attend_carry,
     make_spec_attend_carry_paged,
 )
-from aws_k8s_ansible_provisioner_tpu.ops.sampling import (apply_penalties,
+from aws_k8s_ansible_provisioner_tpu.ops.sampling import (apply_allow,
+                                                           apply_penalties,
                                                            per_slot_keys,
                                                            sample)
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
@@ -173,10 +174,7 @@ def _apply_allow(logits: jnp.ndarray, allow) -> jnp.ndarray:
     uint32."""
     if allow is None:
         return logits
-    V = logits.shape[-1]
-    idx = jnp.arange(V, dtype=jnp.int32)
-    bits = (allow[:, idx >> 5] >> (idx & 31).astype(jnp.uint32)) & jnp.uint32(1)
-    return jnp.where(bits.astype(bool), logits, -jnp.inf)
+    return apply_allow(logits, allow)
 
 
 def _logprob_topk(logits: jnp.ndarray, chosen: jnp.ndarray):
@@ -527,7 +525,7 @@ def mixed_step(cfg: ModelConfig, params, cache, tokens, lengths, ptokens,
                frequency=None, repetition=None, prompt_mask=None,
                penalties: bool = False, table=None, seeds=None,
                ban_ids=None, ban_until=None, bias_ids=None, bias_vals=None,
-               lora_idx=None, bblock: int = 1):
+               allow=None, pallow=None, lora_idx=None, bblock: int = 1):
     """ONE ragged dispatch serving a mixed batch: a decode step for every
     active slot AND one prefill chunk of slot ``pslot`` — the program that
     lets the one-deep pipeline ride across prefill admissions instead of
@@ -554,10 +552,21 @@ def mixed_step(cfg: ModelConfig, params, cache, tokens, lengths, ptokens,
     prefill-admitted slots.
 
     Sampling matches the programs it replaces exactly: decode rows take the
-    decode_steps transform order (penalties → bias → ban(lens) → seeded key
-    at lens + 1); the chunk's last valid row takes prefill_chunk_step's
-    (host rep_seen → bias → ban at pstart + plen → seeded key at
-    pstart + plen). Only the FINAL chunk's sample survives on the host.
+    decode_steps transform order (penalties → bias → ban(lens) → allow →
+    seeded key at lens + 1); the chunk's last valid row takes
+    prefill_chunk_step's (host rep_seen → bias → ban at pstart + plen →
+    allow → seeded key at pstart + plen). Only the FINAL chunk's sample
+    survives on the host.
+
+    Feature operands (ISSUE 16 — no feature de-pipelines the batch):
+    ``allow`` [B, ceil(V/32)] uint32 masks the decode rows (guided slots'
+    FSM bitsets, all-ones elsewhere); ``pallow`` [1, ceil(V/32)] masks the
+    chunk row when the CHUNKING request itself is guided. Both are program
+    variants (None = compiled out). ``lora_idx`` [B] per-slot adapter
+    indices are packed in-program to per-TOKEN indices over the [1, B + C]
+    layout (the chunk rows inherit ``lora_idx[pslot]``), selecting each
+    row's A/B delta inside one shared program (models/layers._linear's
+    per-token branch).
 
     Returns (cache, counts, out [1, B] (+logprobs), chunk token [1]
     (+chunk logprobs), tok_carry [B], lens_carry [B]).
@@ -578,7 +587,15 @@ def mixed_step(cfg: ModelConfig, params, cache, tokens, lengths, ptokens,
     attend = make_mixed_attend_carry_paged(
         write_rows, row_limits, row_tables, impl=impl, mesh=mesh,
         window=cfg.sliding_window, bblock=bblock)
-    with lora_context(lora_idx):
+    # Per-TOKEN adapter indices over the packed layout: decode row b keeps
+    # its slot's adapter, every chunk row runs the chunking slot's — one
+    # program serves any adapter mix (models/layers._linear gathers factors
+    # per token when the index rank matches x's row rank).
+    packed_lora = None
+    if lora_idx is not None:
+        packed_lora = jnp.concatenate(
+            [lora_idx, jnp.broadcast_to(lora_idx[pslot], (C,))])[None]
+    with lora_context(packed_lora):
         logits, cache = model_forward_carry(params, cfg, packed, positions,
                                             cache, attend)
     # -- decode rows: the decode_steps substep body, verbatim order --------
@@ -588,6 +605,7 @@ def mixed_step(cfg: ModelConfig, params, cache, tokens, lengths, ptokens,
                                      repetition, prompt_mask)
     dec_logits = _apply_logit_bias(dec_logits, bias_ids, bias_vals)
     dec_logits = _mask_banned(dec_logits, ban_ids, ban_until, lengths)
+    dec_logits = _apply_allow(dec_logits, allow)
     keys = per_slot_keys(seeds, lengths + 1) if seeds is not None else rng
     nxt = sample(dec_logits, keys, temperature, top_k, top_p)
     if penalties:
@@ -606,6 +624,7 @@ def mixed_step(cfg: ModelConfig, params, cache, tokens, lengths, ptokens,
                               bias_vals[pslot][None])
     plast = _mask_banned(plast, ban_ids[pslot][None], ban_until[pslot][None],
                          (pstart + plen)[None])
+    plast = _apply_allow(plast, pallow)
     pkeys = per_slot_keys(pseed[None], (pstart + plen)[None]) \
         if pseed is not None else rng
     ptok = sample(plast, pkeys, ptemp[None], ptop_k[None], ptop_p[None])
@@ -1129,23 +1148,50 @@ class EnginePrograms:
 
     def _allow_row(self, req: Request):
         """[1, ceil(V/32)] guided allow-bitmask device array for one request,
-        or None (no-variant) when the request is unguided."""
+        or None (no-variant) when the request is unguided.
+
+        One-entry device cache keyed on the request's FSM fingerprint
+        (serving/guided.py): a guided CHUNKING request's state never
+        advances mid-walk, so every mixed dispatch of the walk reuses the
+        same device-resident mask — zero rebuild, zero re-upload (the
+        mask-upload-overlap term in PERF.md's mixed-feature cost model).
+        The upload itself is ``jnp.asarray`` — async enqueue, no blocking
+        read (this helper is on the tpulint R8 dispatch path)."""
         if req.guided is None:
             return None
+        key = (req.id, req.guided.fingerprint())
+        cached = self._allow_dev
+        if cached is not None and cached[0] == key:
+            return cached[1]
         row = np.zeros((1, (self.cfg.vocab_size + 31) // 32), np.uint32)
         self._fill_allow(row, 0, req)
-        return jnp.asarray(row)
+        arr = jnp.asarray(row)
+        self._allow_dev = (key, arr)
+        return arr
 
     def _allow_words(self, gslots: List[int]):
         """[B, ceil(V/32)] allow-bitmask covering all slots (unguided rows
-        all-ones), or None when no guided slot is active."""
+        all-ones), or None when no guided slot is active.
+
+        Same one-entry device cache as _allow_row, keyed on every guided
+        slot's (slot, FSM fingerprint): consecutive dispatches whose
+        grammar states did not advance (e.g. decode steps interleaved
+        around a neighbor's chunk walk) skip both the numpy rebuild and
+        the re-upload."""
         if not gslots:
             return None
+        key = tuple((s, self.slot_req[s].guided.fingerprint())
+                    for s in gslots)
+        cached = self._allow_batch_dev
+        if cached is not None and cached[0] == key:
+            return cached[1]
         aw = np.full((self.num_slots, (self.cfg.vocab_size + 31) // 32),
                      0xFFFFFFFF, np.uint32)
         for s in gslots:
             self._fill_allow(aw, s, self.slot_req[s])
-        return jnp.asarray(aw)
+        arr = jnp.asarray(aw)
+        self._allow_batch_dev = (key, arr)
+        return arr
 
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self.slot_req) if r is None]
@@ -1437,7 +1483,9 @@ class EnginePrograms:
         # (engine.step services _chunk before admissions), so the conditions
         # cannot flip under the walk — except draining, which both branches
         # tolerate.
-        mixed = (self._ragged_on() and req.guided is None
+        mixed = (self._ragged_on()
+                 and (req.guided is None
+                      or self.serving.ragged_features > 0)
                  and (self._inflight is not None
                       or bool(self._active_slots())))
         if not mixed:
@@ -1614,7 +1662,22 @@ class EnginePrograms:
             # _ensure_pages preempted under the in-flight dispatch
             self._drain_decode_pipeline("prefill")
             prev = None
-        if prev is not None:
+        if (prev is not None
+                and any(r is not None and r.guided is not None
+                        for r in self.slot_req)):
+            # A guided DECODE row rides this mixed dispatch and its allow
+            # mask must reflect the post-emit FSM state: settle the
+            # predecessor first (same rule as _do_decode's guided path —
+            # carry retained, no drain counted). The steady-state
+            # dispatch-then-fetch overlap below is kept for unguided
+            # traffic, where no mask depends on the predecessor's emits.
+            # The CHUNKING request's own pallow needs no settle: its FSM
+            # never advances mid-walk (only the final chunk's token is
+            # emitted, at activation).
+            self._settle_inflight()
+            prev = None
+        if self._carry_valid():
+            # valid after a settle too (prev is None, carry retained)
             tok_in, len_in = self._pipe_carry[0], self._pipe_carry[1]
         else:
             tok_in = self._donatable(self.last_token)
@@ -1664,6 +1727,16 @@ class EnginePrograms:
         req, slot, off = st["req"], st["slot"], st["off"]
         ids = st.get("ids") or req.prompt_ids
         active = [s for s in self._active_slots() if s != slot]
+        # Feature operands (ISSUE 16): guided decode rows carry their FSM
+        # allow-bitmask, a guided CHUNKING request carries its own over the
+        # chunk row (constant across the walk — the one-entry device cache
+        # in _allow_row makes re-dispatching it free). Both are async
+        # uploads on the enqueue half (tpulint R8 covers this fn).
+        gslots = [s for s in active
+                  if self.slot_req[s] is not None
+                  and self.slot_req[s].guided is not None]
+        allow = self._allow_words(gslots)
+        pallow = self._allow_row(req)
         oc = self._decode_operands()
         want_lp = self._want_logprobs(self.slot_req)
         want_pen = self.counts is not None and bool(
@@ -1702,6 +1775,8 @@ class EnginePrograms:
             ban_until=oc["ban_until"],
             bias_ids=oc["bias_ids"],
             bias_vals=oc["bias_vals"],
+            allow=allow,
+            pallow=pallow,
             lora_idx=oc["lora"],
             bblock=self.decode_bblock)
         self.counts = new_counts if want_pen else real_counts
@@ -1710,7 +1785,8 @@ class EnginePrograms:
         _flight.record("pipeline_dispatch", None, horizon=1,
                        batch=len(active), mixed=True)
         return {"mixed": True, "out": out, "pout": pout, "horizon": 1,
-                "active": active, "gset": frozenset(), "gslots": [],
+                "active": active, "gset": frozenset(gslots),
+                "gslots": gslots,
                 "want_lp": want_lp, "chunk_lp": chunk_lp,
                 "want_pen": want_pen, "chunk_n": len(chunk), "t0": t0}
 
@@ -1800,6 +1876,13 @@ class EnginePrograms:
             seeds=jnp.asarray(self.seeds), mesh=self.mesh,
             lora_idx=self._lora_vec(),
             bblock=self.decode_bblock)
+        ch = _chaos.get()
+        if ch.enabled:
+            # an armed "ragged_feature_error" raises here, standing in for
+            # a corrupted verify-row transfer: nothing below has emitted, so
+            # the failover path discards the whole dispatch un-emitted and
+            # releases every slot exactly once (engine._fail_all)
+            ch.on_feature_path(self, kind="spec")
         out = np.asarray(out)
         accepted = np.asarray(accepted)
         dt = time.monotonic() - t0
@@ -1845,17 +1928,29 @@ class EnginePrograms:
             toks = sum(n for _, n in self._tok_times)
             if span > 0:
                 self.metrics.tokens_per_second.set(toks / span)
+        # The verify advanced lengths/last_token on the HOST (accept counts
+        # are data-dependent); a carry retained across the preceding settle
+        # no longer matches the mirrors but _carry_gen never moved — drop
+        # it explicitly so the next dispatch re-uploads the synced mirrors
+        # instead of feeding a stale device carry (_carry_valid would
+        # otherwise say yes).
+        self._pipe_carry = None
 
     def _pipeline_on(self) -> bool:
         """May a decode dispatch be left in flight after this step?
 
-        Only on the plain decode path: spec decode proposes from host
-        mirrors (they must be current), chunked prefill interleaves
-        horizon-1 decodes against a half-built slot, and a draining engine
-        must hit "nothing in flight" the moment its last emit goes out.
+        Chunked prefill interleaves horizon-1 decodes against a half-built
+        slot and a draining engine must hit "nothing in flight" the moment
+        its last emit goes out — both always force sync. Spec decode used
+        to as well (its proposer reads host mirrors); with
+        ``ragged_features`` on, the spec branch instead SETTLES the
+        in-flight dispatch (``_settle_inflight`` — carry retained, no drain
+        counted) right before proposing, so plain dispatches between verify
+        rounds keep the pipeline open.
         """
         return (self.serving.decode_pipeline > 0
-                and not self.serving.spec_decode
+                and (self.serving.ragged_features > 0
+                     or not self.serving.spec_decode)
                 and self._chunk is None
                 and not self.draining)
 
@@ -1864,23 +1959,27 @@ class EnginePrograms:
 
         Requires the paged pool (the ragged kernel gathers through per-row
         page tables) and the pipeline itself (the whole point is keeping it
-        open). Gated off for spec decode (host mirrors must stay current),
-        LoRA (the packed [1, B+C] layout cannot apply per-row adapters),
-        multi-group meshes (the packed batch spans dp/sp shards), a
-        draining engine, and any active guided slot (its per-token host-FSM
-        mask cannot ride the packed row). Per-request guided gating happens
-        at the routing sites (``req.guided is None``)."""
+        open). Always gated off for multi-group meshes (the packed batch
+        spans dp/sp shards) and a draining engine. With ``ragged_features``
+        (the default) the feature paths COMPOSE with the mixed program
+        (ISSUE 16): guided slots ride as a per-row allow-mask operand, LoRA
+        as a per-token adapter-index operand, and spec decode settles (not
+        drains) around its verify dispatches. ``ragged_features=0``
+        restores the PR-14 fallback: spec decode, LoRA, and any active
+        guided slot de-pipeline to the sync floor (the byte-identity A/B
+        arm in tests/test_decode_pipeline.py)."""
+        feats = self.serving.ragged_features > 0
         if not (self.serving.ragged_attention > 0 and self.paged
                 and self.serving.decode_pipeline > 0
-                and not self.serving.spec_decode
-                and not self.lora_names
+                and (feats or not self.serving.spec_decode)
+                and (feats or not self.lora_names)
                 and not self.draining):
             return False
         if self.mesh is not None and (self.mesh.shape.get("dp", 1) > 1
                                       or self.mesh.shape.get("sp", 1) > 1):
             return False
-        return not any(r is not None and r.guided is not None
-                       for r in self.slot_req)
+        return feats or not any(r is not None and r.guided is not None
+                                for r in self.slot_req)
 
     def _carry_valid(self) -> bool:
         """True while the device-resident token/length carry of the
@@ -1910,6 +2009,29 @@ class EnginePrograms:
         _metrics.pipeline.drains.inc(reason=reason)
         self._inflight = None
         self._pipe_carry = None
+        self.metrics.pipeline_depth.set(0.0)
+        self._decode_fetch(rec, tail=True)
+
+    def _settle_inflight(self) -> None:
+        """Fetch + emit the in-flight dispatch WITHOUT counting a drain and
+        WITHOUT dropping the device carry.
+
+        The carry-generation handoff (ISSUE 16): a feature path that needs
+        the host mirrors current (spec decode's proposer) or the emits
+        applied (a guided slot's FSM must see token N before masking token
+        N+1) settles the predecessor instead of draining it. Finishing a
+        slot mid-fetch does NOT bump ``_carry_gen`` (the carry's surplus
+        lanes for a finished slot are discarded on emit — see
+        engine._finish), so ``_pipe_carry`` remains valid and the next
+        dispatch feeds it straight back in, device-resident: no host
+        re-upload, no ``tpu_serve_pipeline_drains_total`` increment. Only
+        transitions that REWRITE slot state (activate/preempt/spec-verify
+        host advance) invalidate the carry.
+        """
+        rec = self._inflight
+        if rec is None:
+            return
+        self._inflight = None
         self.metrics.pipeline_depth.set(0.0)
         self._decode_fetch(rec, tail=True)
 
@@ -2033,18 +2155,30 @@ class EnginePrograms:
         # request a batch-wide blast radius). Falls back when no context
         # matched.
         if (self.serving.spec_decode and self._spec_mesh_ok and horizon > 1
-                and not self._spec_plain_due
-                # the verify dispatch writes spec_k + 1 rows for EVERY slot,
-                # so the bound stays global over the active set
-                and self.lengths[active].max(initial=0) + self.serving.spec_k
-                + 1 < self.max_len):
-            skip = {s for s in active if self._slot_spec_ineligible(s)}
-            proposal = self._propose_drafts([s for s in active
-                                             if s not in skip])
-            if proposal is not None:
-                self._do_spec_decode(active, *proposal, skip=skip)
-                self._spec_plain_due = bool(skip)
-                return
+                and not self._spec_plain_due):
+            if prev is not None:
+                # Carry-generation handoff (ISSUE 16): the proposer and the
+                # length bound below read host mirrors, so the in-flight
+                # dispatch is SETTLED first — its emits sync the mirrors,
+                # the carry stays valid, and no drain is counted. The old
+                # mandatory pre-spec drain is gone (with ragged_features=0,
+                # _pipeline_on keeps spec traffic sync and prev is None).
+                self._settle_inflight()
+                prev = None
+                active = self._active_slots()
+                if not active:
+                    return
+            # the verify dispatch writes spec_k + 1 rows for EVERY slot,
+            # so the bound stays global over the active set
+            if (self.lengths[active].max(initial=0) + self.serving.spec_k
+                    + 1 < self.max_len):
+                skip = {s for s in active if self._slot_spec_ineligible(s)}
+                proposal = self._propose_drafts([s for s in active
+                                                 if s not in skip])
+                if proposal is not None:
+                    self._do_spec_decode(active, *proposal, skip=skip)
+                    self._spec_plain_due = bool(skip)
+                    return
         self._spec_plain_due = False
         # Guided decoding: the grammar mask is valid for ONE token (the host
         # FSM must see token N before masking token N+1), but capping the
@@ -2064,6 +2198,27 @@ class EnginePrograms:
             s for s in active
             if self.slot_req[s] is not None
             and self.slot_req[s].guided is not None)
+        feats = self.serving.ragged_features > 0
+        if feats and gset and prev is not None:
+            # Guided mask freshness: _decode_dispatch builds the allow rows
+            # from each guided slot's host FSM, which only advances when the
+            # predecessor's tokens are EMITTED — settle it first (fetch +
+            # emit, carry retained, NO drain counted), then dispatch against
+            # the post-advance grammar states. The mask upload itself is
+            # async (jnp.asarray on the dispatch half — tpulint R8 allows
+            # enqueue-side uploads; only blocking READS are banned), so the
+            # per-row operand rides one step ahead of the device exactly
+            # like the token carry.
+            self._settle_inflight()
+            prev = None
+            active = self._active_slots()
+            if not active:
+                # the settle's emits finished every slot (EOS mid-stream)
+                return
+            gset = frozenset(
+                s for s in active
+                if self.slot_req[s] is not None
+                and self.slot_req[s].guided is not None)
         if gset and not any(self.slot_req[s] is not None and s not in gset
                             for s in active):
             horizon = 1
@@ -2072,16 +2227,18 @@ class EnginePrograms:
         want_pen = self.counts is not None and bool(
             self.pres_pens.any() or self.freq_pens.any()
             or (self.rep_pens != 1.0).any())
-        if prev is not None:
+        if self._carry_valid():
             # device-resident carry: dispatch N's final token/length arrays
-            # feed dispatch N+1 directly (donated) — no host round-trip
+            # feed dispatch N+1 directly (donated) — no host round-trip.
+            # Still valid after a settle (prev is None but the carry
+            # survives — _settle_inflight's contract).
             tok_in, len_in = self._pipe_carry[0], self._pipe_carry[1]
         else:
             tok_in = self._donatable(self.last_token)
             len_in = self._donatable(self.lengths)
         rec = self._decode_dispatch(horizon, active, gset, gslots, want_lp,
                                     want_pen, tok_in, len_in)
-        if self._pipeline_on() and not gset:
+        if self._pipeline_on() and (feats or not gset):
             # leave the new dispatch in flight: its fetch is deferred to
             # the next decode step (or a pipeline drain), so the entire
             # emit/SSE/scheduling gap between dispatches overlaps device
@@ -2187,6 +2344,13 @@ class EnginePrograms:
                 # dispatches — the in-flight record is discarded and the
                 # chunk walk's error path releases its slot exactly once
                 ch.on_mixed_fetch(self)
+            if rec.get("gslots"):
+                # an armed "ragged_feature_error" targets dispatches whose
+                # allow-mask operand was live (guided rows), standing in
+                # for a corrupted mask upload: the record is discarded
+                # UN-EMITTED (no token below ever reached a stream) and
+                # the failover path releases pages/slots exactly once
+                ch.on_feature_path(self, kind="guided")
         out = rec["out"]
         lp_t = None
         if rec["want_lp"]:
@@ -2226,7 +2390,7 @@ class EnginePrograms:
                      + rec.get("chunk_n", 0),
                      ctx_rows=float(np.mean(self.lengths[
                          list(rec["active"])])) if rec["active"] else 0.0,
-                     steps=horizon)
+                     steps=horizon, guided_rows=len(rec["gslots"]))
         gset = rec["gset"]
         emitted = 0
         for s in range(horizon):

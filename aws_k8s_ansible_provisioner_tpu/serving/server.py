@@ -410,6 +410,10 @@ class Handler(BaseHTTPRequestHandler):
                 # ragged path should hold drain_rate ~0 where the legacy
                 # path pays one drain per admission.
                 "ragged_attention": eng.serving.ragged_attention,
+                # Feature paths riding the ragged pipeline (ISSUE 16):
+                # 0 means spec/LoRA/guided still de-pipeline to the sync
+                # floor (the PR-14 fallback arm).
+                "ragged_features": eng.serving.ragged_features,
                 "pipeline": metrics.pipeline.snapshot(),
                 "weights_dtype": eng.serving.weights_dtype,
                 "kv_dtype": eng.serving.kv_dtype,
@@ -1751,6 +1755,13 @@ def main(argv=None):
                         "draining the decode pipeline. 0 restores the "
                         "legacy serialized chunk walk (sync escape hatch; "
                         "seeded streams stay byte-identical)")
+    p.add_argument("--ragged-features", type=int, default=1,
+                   help="feature paths ride the ragged pipeline: guided "
+                        "decoding's FSM mask becomes a device-resident "
+                        "per-row operand, LoRA rows select adapters inside "
+                        "the packed layout, and spec-decode verify hands "
+                        "the carry off without draining. 0 restores the "
+                        "per-feature sync fallback (byte-identity A/B arm)")
     p.add_argument("--chat-template", default="",
                    help="path to a Jinja chat template file")
     p.add_argument("--platform", default="",
@@ -1903,6 +1914,7 @@ def main(argv=None):
         decode_bblock=args.decode_bblock,
         decode_pipeline=args.decode_pipeline,
         ragged_attention=args.ragged_attention,
+        ragged_features=args.ragged_features,
         checkpoint_dir=args.checkpoint_dir, chat_template=args.chat_template,
         prefill_chunk=args.prefill_chunk,
         prefix_cache=not args.no_prefix_cache,

@@ -1012,6 +1012,208 @@ def ragged() -> None:
         f.write("\n")
 
 
+def mixed_features() -> None:
+    """Feature-vs-plain A/B on the ragged pipeline (the fallback-tax bench).
+
+    ISSUE 16's claim: spec decode, guided decoding, and LoRA ride the same
+    ragged mixed-batch pipeline as vanilla traffic, so a workload mixing ALL
+    of them (spec + guided + LoRA + chunked prefill, concurrently) holds
+    within 10% of plain-traffic tok/s with ZERO feature-reason pipeline
+    drains — where the PR-14 gating de-pipelined every tenant the moment
+    one guided or LoRA request was admitted. Two engines in one process run
+    the same workload shape: run A is a featureless engine under plain
+    traffic, run B enables spec decode, loads a LoRA adapter, and tags the
+    traffic with grammars/adapters. Reads the engine's own token counters
+    plus the pipeline drain ledger (serving/metrics.py PipelineMetrics) and
+    writes BENCH_mixedfeat_r01.json. Run B must keep
+    drains{prefill,chunk,spec,guided} == 0 and land >= 0.9x run A's tok/s.
+    """
+    import json as _json
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from aws_k8s_ansible_provisioner_tpu.config import (ServingConfig,
+                                                        tiny_qwen3)
+    from aws_k8s_ansible_provisioner_tpu.models.layers import init_params
+    from aws_k8s_ansible_provisioner_tpu.serving import metrics as _smetrics
+    from aws_k8s_ansible_provisioner_tpu.serving.engine import Engine, Request
+    from aws_k8s_ansible_provisioner_tpu.serving.guided import grammar_for
+    from aws_k8s_ansible_provisioner_tpu.utils.tokenizer import ByteTokenizer
+
+    batch = int(os.environ.get("TPU_BENCH_MIXEDFEAT_BATCH", "4"))
+    prompts = int(os.environ.get("TPU_BENCH_MIXEDFEAT_PROMPTS", "6"))
+    plen = int(os.environ.get("TPU_BENCH_MIXEDFEAT_PROMPT_LEN", "96"))
+    chunk = int(os.environ.get("TPU_BENCH_MIXEDFEAT_CHUNK", "16"))
+    # background streams must OUTLIVE the timed churn window (the batch is
+    # never pure-guided, so mixed batches keep the fused horizon): sized to
+    # the cache, finished untimed after the window closes
+    bg_toks = int(os.environ.get("TPU_BENCH_MIXEDFEAT_BG_TOKENS", "450"))
+
+    tok = ByteTokenizer()
+    cfg = tiny_qwen3(vocab_size=tok.vocab_size,
+                     eos_token_id=tok.eos_token_id)
+
+    def write_adapter(tmp: str) -> str:
+        """Minimal peft-format adapter dir (rank-4, q/v/up targets)."""
+        from safetensors import numpy as st_np
+
+        rng = np.random.default_rng(7)
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "adapter_config.json"), "w",
+                  encoding="utf-8") as f:
+            f.write(_json.dumps({
+                "peft_type": "LORA", "r": 4, "lora_alpha": 8,
+                "target_modules": ["q_proj", "v_proj", "up_proj"]}))
+        dims = {"q_proj": (cfg.q_size, cfg.hidden_size),
+                "v_proj": (cfg.kv_size, cfg.hidden_size),
+                "up_proj": (cfg.intermediate_size, cfg.hidden_size)}
+        tensors = {}
+        for layer in range(cfg.num_layers):
+            for t, (dout, din) in dims.items():
+                mod = "mlp" if t == "up_proj" else "self_attn"
+                base = f"base_model.model.model.layers.{layer}.{mod}.{t}"
+                tensors[f"{base}.lora_A.weight"] = \
+                    (0.05 * rng.standard_normal((4, din))).astype(np.float32)
+                tensors[f"{base}.lora_B.weight"] = \
+                    (0.05 * rng.standard_normal((dout, 4))).astype(np.float32)
+        st_np.save_file(tensors,
+                        os.path.join(tmp, "adapter_model.safetensors"))
+        return tmp
+
+    # grammar bias: pressure the random-weight model toward closing the
+    # JSON object (tests/test_guided.py's _PRESSURE) so guided streams
+    # finish instead of wandering the grammar until max_tokens
+    eos = tok.eos_token_id
+    pressure = ((ord(' '), -50.0), (ord('\t'), -50.0), (ord('\n'), -50.0),
+                (ord('\r'), -50.0), (ord('['), -20.0), (ord('\\'), -100.0),
+                (ord('"'), 30.0), (ord('}'), 20.0), (ord(']'), 15.0),
+                (ord(':'), 20.0), (ord(','), 5.0), (eos, 100.0))
+
+    def feature_drains() -> int:
+        by = _smetrics.pipeline.snapshot().get("drains_by_reason", {})
+        return int(by.get("spec", 0)) + int(by.get("guided", 0))
+
+    def edge_drains() -> int:
+        by = _smetrics.pipeline.snapshot().get("drains_by_reason", {})
+        return int(by.get("prefill", 0)) + int(by.get("chunk", 0))
+
+    def run(features: bool, adapter_dir: str) -> dict:
+        serving = ServingConfig(
+            model="tiny-qwen3", max_decode_slots=batch + 2,
+            max_cache_len=512, prefill_buckets=(32,), decode_horizon=4,
+            prefill_chunk=chunk, decode_pipeline=1, ragged_attention=1,
+            ragged_features=1, dtype="float32",
+            spec_decode=features, spec_k=4, spec_ngram=3)
+        params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+        engine = Engine(cfg, params, serving,
+                        lora={"mf": adapter_dir} if features else None)
+        engine.warmup(scope="bench")
+        g = grammar_for(tok, {"type": "json_object"}, [eos]) \
+            if features else None
+
+        def background(i: int):
+            return engine.submit(Request(
+                prompt_ids=tok.encode("ab" * 8), max_tokens=bg_toks,
+                ignore_eos=True, temperature=0.0,
+                lora=("mf" if features and i % 2 == 0 else None)))
+
+        churn, done = [], []
+        # Background decode rows occupying `batch` slots for the WHOLE
+        # window: greedy repetitive prompts (spec-friendly); half carry the
+        # adapter in the feature run.
+        bg = [background(i) for i in range(batch)]
+        while engine.pending:
+            engine.step()
+        for _ in range(5):
+            engine.step()           # warm the decode path / fill the pipe
+        m = engine.metrics
+        toks0 = m.generated_tokens.total()
+        fd0, ed0 = feature_drains(), edge_drains()
+        disp0 = _smetrics.pipeline.snapshot()["dispatches_total"]
+        t0 = time.monotonic()
+        # Churn phase through the two spare slots: long chunking prompts
+        # interleaved with guided (feature run) or bias-identical plain
+        # (plain run) short jobs. The window closes when the churn clears —
+        # the backgrounds are still decoding, so the timed region is the
+        # steady mixed state, not a guided-only tail.
+        for i in range(prompts):
+            churn.append(engine.submit(Request(
+                prompt_ids=tok.encode("x" * plen), max_tokens=4,
+                temperature=0.0, seed=500 + i)))
+            churn.append(engine.submit(Request(
+                prompt_ids=tok.encode("json:"), max_tokens=24,
+                temperature=0.0, logit_bias=pressure,
+                guided=g, seed=900 + i)))
+        while not all(r.finish_reason for r in churn):
+            engine.step()
+            # Keep every background slot occupied: the timed region must
+            # stay the steady MIXED state. Spec decode finishes backgrounds
+            # ~5x sooner in the feature run; a drained background slot would
+            # tip the batch toward pure-guided (horizon 1) and measure a
+            # different workload than the plain arm.
+            for i, r in enumerate(bg):
+                if r.finish_reason:
+                    done.append(r)
+                    bg[i] = background(i)
+        dt = time.monotonic() - t0
+        toks = m.generated_tokens.total() - toks0
+        while not all(r.finish_reason for r in bg):   # untimed run-out
+            engine.step()
+        if engine._inflight is not None:
+            # trailing in-flight dispatch (reason "drain": deliberate,
+            # excluded from the tax ledger)
+            engine._drain_decode_pipeline()
+        bad = [r.finish_reason for r in bg + done + churn
+               if r.finish_reason not in ("stop", "length")]
+        assert not bad, bad
+        return {
+            "toks_per_s": toks / dt,
+            "feature_drains": feature_drains() - fd0,
+            "edge_drains": edge_drains() - ed0,
+            "dispatches": _smetrics.pipeline.snapshot()["dispatches_total"]
+            - disp0,
+            "wall_s": dt,
+        }
+
+    with tempfile.TemporaryDirectory() as tmp:
+        adapter = write_adapter(os.path.join(tmp, "mf"))
+        plain, feat = run(False, adapter), run(True, adapter)
+    ratio = feat["toks_per_s"] / max(1e-9, plain["toks_per_s"])
+    out = {
+        "bench": "mixedfeat", "rev": "r01",
+        "model": "tiny-qwen3", "platform": jax.devices()[0].platform,
+        "batch": batch, "prompts": prompts, "prompt_len": plen,
+        "prefill_chunk": chunk, "spec_k": 4,
+        "plain_toks_per_s": round(plain["toks_per_s"], 1),
+        "mixedfeat_toks_per_s": round(feat["toks_per_s"], 1),
+        "mixedfeat_ratio": round(ratio, 3),
+        # the structural claim: feature traffic pays ZERO pipeline drains —
+        # no spec pre-drain, no guided de-pipelining, no admission edges
+        "feature_drains": feat["feature_drains"],
+        "edge_drains": feat["edge_drains"],
+        "plain_dispatches": plain["dispatches"],
+        "mixedfeat_dispatches": feat["dispatches"],
+        "plain_wall_s": round(plain["wall_s"], 3),
+        "mixedfeat_wall_s": round(feat["wall_s"], 3),
+    }
+    print(json.dumps(out), flush=True)
+    if not (ratio >= 0.9 and feat["feature_drains"] == 0
+            and feat["edge_drains"] == 0):
+        raise SystemExit(f"mixedfeat bench: feature traffic paid the "
+                         f"fallback tax ({out})")
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_mixedfeat_r01.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+
+
 if __name__ == "__main__":
     if "--measure" in sys.argv:
         measure()
@@ -1023,6 +1225,8 @@ if __name__ == "__main__":
         pipeline()
     elif "--ragged" in sys.argv:
         ragged()
+    elif "--mixed-features" in sys.argv:
+        mixed_features()
     elif "--dry" in sys.argv:
         # Seconds-class CPU pass over the tiny model, in-process: proves the
         # whole field plumbing (bblock, weights_dtype, dma_steps_per_substep,

@@ -21,7 +21,16 @@ behind device execution. These tests pin the correctness contract:
   path drains once per admission), seeded streams are byte-identical ragged
   vs legacy across sampled/logprobs/penalties, and the injected
   ``ragged_dispatch_error`` fault drops the mixed dispatch without killing
-  the engine.
+  the engine;
+- feature paths ride the ragged pipeline (ISSUE 16, same marker): guided,
+  LoRA, and spec-decode traffic stays pipelined under ``ragged_features=1``
+  with seeded streams byte-identical to the ``ragged_features=0`` sync
+  fallback, zero spec/guided-reason drains on
+  tpu_serve_pipeline_drains_total, and the injected ``ragged_feature_error``
+  fault (corrupted guided-mask upload / spec verify row, ``kind=...``
+  selectable) discards the dispatch un-emitted while the engine keeps
+  serving — including a chaos-seasoned workload mixing all features at
+  once.
 
 `make pipeline-smoke` runs this file LockSan-instrumented (TPU_LOCKSAN=1);
 `make ragged-smoke` runs the ragged subset; tier-1 runs it bare via the
@@ -167,8 +176,9 @@ def test_seeded_streams_byte_identical_pipeline_on_off(model):
 
 
 def test_guided_request_and_neighbor_identical_pipeline_on_off(model):
-    """Guided slots force per-dispatch sync decode; the pipeline must hand
-    over cleanly AND leave the unguided neighbor's seeded stream intact."""
+    """Guided slots ride the pipeline (ISSUE 16: the mask is a per-row
+    operand, settled-then-dispatched for FSM freshness); the handover must
+    be byte-exact AND leave the unguided neighbor's seeded stream intact."""
     tok, _, _ = model
 
     def run(pipeline):
@@ -478,6 +488,258 @@ def test_ragged_dispatch_error_drops_dispatch_keeps_serving(model):
         assert ok.wait(timeout=30.0)
         assert ok.finish_reason == "length"
         assert len(ok.generated) == 6
+        _assert_released(eng)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+# -- feature paths ride the ragged pipeline (ISSUE 16) -----------------------
+
+
+def _feature_drains() -> int:
+    """Fallback-tax drains: the spec + guided reasons of the process-wide
+    tpu_serve_pipeline_drains_total ledger — exactly the drains the
+    feature-path refactor (``ragged_features=1``) exists to eliminate
+    (end-of-run idle settles count under 'drain' and are expected)."""
+    by = _metrics.pipeline.snapshot()["drains_by_reason"]
+    return by.get("spec", 0) + by.get("guided", 0)
+
+
+@pytest.mark.ragged_smoke
+def test_guided_streams_byte_identical_ragged_features_on_off(model):
+    """ragged_features=1 keeps guided slots ON the pipeline (the FSM mask is
+    a device-resident per-row operand, settled-then-dispatched for
+    freshness); ragged_features=0 restores the PR-14 sync gating. Guided,
+    unguided-neighbor, and chunked-admission streams must be byte-identical
+    across the two arms, with ZERO guided- and admission-reason drains on
+    the riding arm. The fallback arm never restarts the pipeline while a
+    guided slot is live, so it dispatches strictly less — asserted as the
+    vacuousness guard."""
+    tok, _, _ = model
+
+    def run(feats):
+        eng = _ragged_engine(model, 1, ragged_features=feats)
+        g = grammar_for(tok, {"type": "json_object"}, [tok.eos_token_id])
+        first = eng.submit(Request(prompt_ids=[5, 9, 2], max_tokens=100,
+                                   temperature=0.9, seed=42,
+                                   ignore_eos=True))
+        # get the neighbor decoding — pipelined, so the guided admission
+        # below lands with a dispatch in flight (the handover under test)
+        for _ in range(6):
+            eng.step()
+        snap = _metrics.pipeline.snapshot()
+        before = (_feature_drains(), _edge_drains(),
+                  snap["dispatches_total"])
+        guided = eng.generate(tok.encode("json:"), guided=g, max_tokens=100,
+                              temperature=0.0, logit_bias=_PRESSURE)
+        for _ in range(10):
+            eng.step()
+        late = eng.submit(Request(prompt_ids=list(_LONG_A), max_tokens=8,
+                                  temperature=0.9, seed=7, ignore_eos=True))
+        _drain(eng)
+        snap = _metrics.pipeline.snapshot()
+        after = (_feature_drains(), _edge_drains(), snap["dispatches_total"])
+        return eng, (first, guided, late), \
+            tuple(b - a for a, b in zip(before, after))
+
+    eng1, on, (on_feat, on_edge, on_disp) = run(1)
+    eng0, off, (_, _, off_disp) = run(0)
+    assert on[1].finish_reason == "stop"
+    assert isinstance(json.loads(tok.decode(on[1].generated)), dict)
+    for a, b in zip(on, off):
+        assert _stream_bytes(a) == _stream_bytes(b), \
+            "guided traffic on the pipeline must match the sync fallback"
+    assert on_feat == 0, \
+        f"guided slot de-pipelined {on_feat}x on the riding arm"
+    assert on_edge == 0, \
+        f"guided admission paid {on_edge} edge drains on the riding arm"
+    assert on_disp > off_disp, \
+        "riding arm should out-dispatch the sync fallback (test is vacuous)"
+    _assert_released(eng1)
+    _assert_released(eng0)
+
+
+@pytest.mark.ragged_smoke
+def test_lora_streams_byte_identical_ragged_features_on_off(model, tmp_path):
+    """Adapter rows ride the mixed dispatch via the per-row adapter-index
+    operand (packed ``[1, B+C]`` A/B deltas); ragged_features=0 de-pipelines
+    them to the per-slot legacy path. Tuned, base-neighbor, and
+    chunked-tuned streams must be byte-identical across the two arms."""
+    from test_lora import _write_adapter
+    tok, cfg, params = model
+    path = _write_adapter(tmp_path, "ad", cfg, seed=3)
+
+    def run(feats):
+        serving = ServingConfig(
+            weights_dtype="bf16", model=MODEL, max_decode_slots=2,
+            max_cache_len=256, page_size=32,
+            prefill_buckets=(16, 32, 64, 128), dtype="float32",
+            derived_seed=0, decode_pipeline=1, ragged_attention=1,
+            ragged_features=feats, prefill_chunk=32, decode_horizon=4)
+        eng = Engine(cfg, params, serving, lora={"ad": path})
+        tuned = eng.submit(Request(prompt_ids=[5, 9, 2], max_tokens=12,
+                                   temperature=0.9, seed=11,
+                                   ignore_eos=True, lora="ad"))
+        base = eng.submit(Request(**SEEDED))
+        for _ in range(4):
+            eng.step()
+        late = eng.submit(Request(prompt_ids=list(_LONG_B), max_tokens=8,
+                                  temperature=0.8, seed=13, ignore_eos=True,
+                                  lora="ad"))
+        _drain(eng)
+        return eng, (tuned, base, late)
+
+    eng1, on = run(1)
+    eng0, off = run(0)
+    for a, b in zip(on, off):
+        assert _stream_bytes(a) == _stream_bytes(b), \
+            "LoRA traffic on the pipeline must match the per-slot fallback"
+    assert all(r.finish_reason == "length" for r in on)
+    _assert_released(eng1)
+    _assert_released(eng0)
+
+
+@pytest.mark.ragged_smoke
+def test_spec_streams_byte_identical_ragged_features_on_off(model):
+    """Spec verify rides the ragged dispatch family via the
+    carry-generation handoff (ragged_features=1) where ragged_features=0
+    keeps the PR-14 mandatory pre-spec pipeline drain. Greedy spec-friendly
+    streams, a seeded sampled neighbor, and a chunked admission must be
+    byte-identical across the arms; the riding arm drafts real tokens and
+    pays ZERO spec-reason drains."""
+    tok, _, _ = model
+
+    def run(feats):
+        eng = _ragged_engine(model, 1, ragged_features=feats,
+                             spec_decode=True, spec_k=4, spec_ngram=3)
+        before = _feature_drains()
+        rep = eng.submit(Request(prompt_ids=tok.encode("ab" * 8),
+                                 max_tokens=40, temperature=0.0,
+                                 ignore_eos=True))
+        neighbor = eng.submit(Request(**SEEDED))
+        for _ in range(6):
+            eng.step()
+        late = eng.submit(Request(prompt_ids=list(_LONG_B), max_tokens=8,
+                                  temperature=0.8, seed=13,
+                                  ignore_eos=True))
+        _drain(eng)
+        drafted = eng.metrics.spec_drafted_tokens.total()
+        return eng, (rep, neighbor, late), _feature_drains() - before, drafted
+
+    eng1, on, on_drains, on_drafted = run(1)
+    eng0, off, _, off_drafted = run(0)
+    for a, b in zip(on, off):
+        assert _stream_bytes(a) == _stream_bytes(b), \
+            "spec traffic on the pipeline must match the drain-first arm"
+    assert on_drafted > 0 and off_drafted > 0, \
+        "spec decode never proposed drafts (test is vacuous)"
+    assert on_drains == 0, \
+        f"spec verify drained the pipeline {on_drains}x on the riding arm"
+    _assert_released(eng1)
+    _assert_released(eng0)
+
+
+@pytest.mark.ragged_smoke
+@pytest.mark.parametrize("kind", ["guided", "spec"])
+def test_ragged_feature_error_drops_dispatch_keeps_serving(model, kind):
+    """chaos.py contract for ``ragged_feature_error``: a corrupted guided
+    mask upload / spec verify-row transfer discards the dispatch UN-EMITTED,
+    affected requests fail with 'error', slots/pages release exactly once,
+    and the engine keeps serving (drop-not-fail)."""
+    tok, _, _ = model
+    _chaos.get().inject("ragged_feature_error", times=1, kind=kind)
+    eng = _ragged_engine(model, 1,
+                         **(dict(spec_decode=True, spec_k=4, spec_ngram=3)
+                            if kind == "spec" else {}))
+    stop = threading.Event()
+    t = threading.Thread(target=eng.run_forever, args=(stop,), daemon=True)
+    t.start()
+    try:
+        if kind == "guided":
+            g = grammar_for(tok, {"type": "json_object"},
+                            [tok.eos_token_id])
+            victim = eng.generate(tok.encode("json:"), guided=g,
+                                  max_tokens=100, temperature=0.0,
+                                  logit_bias=_PRESSURE)
+        else:
+            victim = eng.generate(tok.encode("ab" * 8), max_tokens=40,
+                                  temperature=0.0, ignore_eos=True)
+        victim.wait(timeout=30.0)
+        assert victim.finish_reason == "error", victim.finish_reason
+        st = _chaos.get().stats()["ragged_feature_error"]
+        assert st["fired"] == 1, st
+        # tokens streamed by dispatches BEFORE the fault stay; the faulted
+        # dispatch itself was discarded un-emitted — nothing may surface
+        # after the error lands (a late emit would mean the record leaked)
+        frozen = list(victim.generated)
+        assert len(frozen) < victim.max_tokens
+        assert eng._inflight is None
+        assert eng.metrics.pipeline_depth.value() == 0.0
+        # recovery: the same engine completes a fresh request normally
+        ok = eng.generate([2, 4, 6], max_tokens=6, temperature=0.0,
+                          ignore_eos=True)
+        assert ok.wait(timeout=30.0)
+        assert ok.finish_reason == "length"
+        assert len(ok.generated) == 6
+        assert victim.generated == frozen, \
+            "discarded dispatch emitted after the error"
+        _assert_released(eng)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+@pytest.mark.ragged_smoke
+def test_chaos_seasoned_mixed_features_zero_feature_drains(model, tmp_path):
+    """The acceptance workload: spec + guided + LoRA + chunked prefill all
+    concurrently, seasoned with a mid-run ``ragged_feature_error`` — the
+    drain ledger stays at ZERO for every reason except the deliberate ones
+    ('fail' for the injected fault, 'drain' for idle settles), and the
+    engine finishes a clean follow-up wave after the fault."""
+    from test_lora import _write_adapter
+    tok, cfg, params = model
+    path = _write_adapter(tmp_path, "ad", cfg, seed=3)
+    serving = ServingConfig(
+        weights_dtype="bf16", model=MODEL, max_decode_slots=2,
+        max_cache_len=256, page_size=32,
+        prefill_buckets=(16, 32, 64, 128), dtype="float32",
+        derived_seed=0, decode_pipeline=1, ragged_attention=1,
+        ragged_features=1, prefill_chunk=32, decode_horizon=4,
+        spec_decode=True, spec_k=4, spec_ngram=3)
+    eng = Engine(cfg, params, serving, lora={"ad": path})
+    g = grammar_for(tok, {"type": "json_object"}, [tok.eos_token_id])
+    by0 = dict(_metrics.pipeline.snapshot()["drains_by_reason"])
+    _chaos.get().inject("ragged_feature_error", after=2, times=1)
+    stop = threading.Event()
+    t = threading.Thread(target=eng.run_forever, args=(stop,), daemon=True)
+    t.start()
+    try:
+        def wave():
+            reqs = [
+                eng.generate(tok.encode("ab" * 8), max_tokens=24,
+                             temperature=0.0, ignore_eos=True, lora="ad"),
+                eng.generate(tok.encode("json:"), guided=g, max_tokens=60,
+                             temperature=0.0, logit_bias=_PRESSURE),
+                eng.generate(list(_LONG_A), max_tokens=8, temperature=0.9,
+                             ignore_eos=True),
+            ]
+            for r in reqs:
+                r.wait(timeout=60.0)
+            return reqs
+
+        first = wave()          # the armed fault fires somewhere in here
+        again = wave()          # post-fault: everything serves clean
+        for r in again:
+            assert r.finish_reason in ("stop", "length"), r.finish_reason
+        # at least one wave-1 victim died on the injected fault; nothing
+        # hangs, nothing double-releases
+        assert all(r.finish_reason for r in first)
+        by1 = _metrics.pipeline.snapshot()["drains_by_reason"]
+        for reason in ("prefill", "chunk", "spec", "guided"):
+            got = by1.get(reason, 0) - by0.get(reason, 0)
+            assert got == 0, \
+                f"feature workload paid {got} '{reason}' pipeline drains"
         _assert_released(eng)
     finally:
         stop.set()

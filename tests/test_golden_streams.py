@@ -124,6 +124,72 @@ def test_moe_stream_matches_hf_with_router_ties():
 
 
 # ---------------------------------------------------------------------------
+# Feature paths on the ragged pipeline vs HF (ISSUE 16): spec decode's
+# accept/reject rule and the per-row LoRA operand must both be invisible in
+# the greedy stream — pinned against torch, not against our own sync engine.
+# ---------------------------------------------------------------------------
+
+_RAGGED_FEATS = dict(page_size=32, decode_pipeline=1, ragged_attention=1,
+                     ragged_features=1)
+
+
+def test_spec_stream_on_ragged_pipeline_matches_hf_generate():
+    """Spec decode is lossless for greedy decoding — and stays lossless now
+    that verify rides the ragged pipeline (carry-generation handoff instead
+    of a pre-spec drain). A repetitive prompt makes the n-gram drafter
+    actually propose, so acceptance arithmetic is really exercised."""
+    cfg = tiny_qwen3()
+    model = _hf_qwen3(cfg)
+    params = convert_state_dict(cfg, dict(model.state_dict()),
+                                dtype=jnp.float32)
+    prompt = [5, 9, 2, 11] * 5
+    ref = _hf_greedy(model, prompt, N_NEW)
+    eng = Engine(cfg, params, ServingConfig(
+        weights_dtype="bf16", max_decode_slots=2, max_cache_len=128,
+        prefill_buckets=(32,), dtype="float32", prefix_cache=False,
+        decode_horizon=4, spec_decode=True, spec_k=4, spec_ngram=3,
+        **_RAGGED_FEATS))
+    req = eng.submit(Request(prompt_ids=list(prompt), max_tokens=N_NEW,
+                             ignore_eos=True))
+    for _ in range(10000):
+        if not eng.step():
+            break
+    assert req.generated == ref, "spec-on-pipeline stream diverged from HF"
+    assert eng.metrics.spec_drafted_tokens.total() > 0, \
+        "drafter never proposed (test is vacuous)"
+
+
+def test_zero_b_lora_stream_on_ragged_pipeline_matches_hf_generate(tmp_path):
+    """A zero-B adapter is algebraically a no-op: the tuned row — packed
+    into the mixed dispatch via the per-row adapter-index operand, beside a
+    base-weight neighbor — must reproduce the BASE model's HF greedy stream
+    exactly. Catches adapter-delta leakage across packed rows."""
+    from test_lora import _write_adapter
+
+    cfg = tiny_qwen3()
+    model = _hf_qwen3(cfg)
+    params = convert_state_dict(cfg, dict(model.state_dict()),
+                                dtype=jnp.float32)
+    path = _write_adapter(tmp_path, "zero", cfg, zero_b=True)
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(2, cfg.vocab_size, 13).tolist()
+    ref = _hf_greedy(model, prompt, N_NEW)
+    eng = Engine(cfg, params, ServingConfig(
+        weights_dtype="bf16", max_decode_slots=2, max_cache_len=128,
+        prefill_buckets=(16, 32), dtype="float32", prefix_cache=False,
+        decode_horizon=4, **_RAGGED_FEATS), lora={"zero": path})
+    tuned = eng.submit(Request(prompt_ids=list(prompt), max_tokens=N_NEW,
+                               ignore_eos=True, lora="zero"))
+    base = eng.submit(Request(prompt_ids=list(prompt), max_tokens=N_NEW,
+                              ignore_eos=True))
+    for _ in range(10000):
+        if not eng.step():
+            break
+    assert tuned.generated == ref, "zero-B adapter bent the greedy stream"
+    assert base.generated == ref, "base neighbor perturbed by adapter row"
+
+
+# ---------------------------------------------------------------------------
 # Chat-template renders vs HF apply_chat_template (same shipped Jinja)
 # ---------------------------------------------------------------------------
 

@@ -546,6 +546,55 @@ def test_r8_clean_in_fetch_helper_and_elsewhere(tmp_path):
     assert fs == []
 
 
+def test_r8_fires_on_feature_path_plumbing(tmp_path):
+    """ISSUE 16 extension: the guided-mask builders and the settle helper
+    are ON the dispatch path — masks must UPLOAD asynchronously (a host
+    read there re-introduces the per-token FSM sync the refactor removed),
+    and the settle helper enqueues before fetching."""
+    fs = _lint(tmp_path, {"pkg/serving/a.py": """
+        import numpy as np
+        import jax
+
+        class E:
+            def _allow_words(self, gset):
+                mask = self.build()
+                return np.asarray(mask)
+
+            def _allow_row(self, slot):
+                return jax.device_get(self.mask)
+
+            def _settle_inflight(self):
+                rec = self._inflight
+                rec["out"].block_until_ready()
+                return rec
+    """}, only=["R8"])
+    assert _rules_of(fs) == ["R8", "R8", "R8"]
+    assert "np.asarray" in fs[0].message
+    assert "device_get" in fs[1].message
+    assert "block_until_ready" in fs[2].message
+
+
+def test_r8_clean_feature_path_async_upload(tmp_path):
+    fs = _lint(tmp_path, {"pkg/serving/a.py": """
+        import jax.numpy as jnp
+
+        class E:
+            def _allow_words(self, gset):
+                # device_put-style async upload: no host readback
+                return jnp.asarray(self.bits)
+
+            def _settle_inflight(self):
+                # fetch via the sanctioned block point only
+                rec, self._inflight = self._inflight, None
+                self._decode_fetch(rec, tail=True)
+
+            def _decode_fetch(self, rec, tail=False):
+                import numpy as np
+                return np.asarray(rec["out"])
+    """}, only=["R8"])
+    assert fs == []
+
+
 def test_r8_pragma_with_reason_suppresses(tmp_path):
     fs = _lint(tmp_path, {"pkg/serving/a.py": """
         import numpy as np

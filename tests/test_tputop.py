@@ -245,6 +245,48 @@ def test_render_pipeline_drain_column():
     assert row_c.split()[drain_i] == "-"
 
 
+def test_render_drain_column_reason_tags_golden_frame():
+    """ISSUE 16: the drain column splits by REASON — compact ``sp``/``gd``
+    (and ``pf``/``ch``/``x``) tags name the path a replica is paying its
+    drains on, so a fleet where feature traffic fell off the pipeline is
+    visible at a glance. The rate stays the cell's first token (older
+    assertions and eyeballs keep working); zero counts and the deliberate
+    idle 'drain' reason render no tag at all."""
+    taxed = _healthy()
+    taxed["pipeline"] = {"drains_total": 12, "dispatches_total": 100,
+                         "drain_rate": 0.12,
+                         "drains_by_reason": {"spec": 7, "guided": 4,
+                                              "drain": 1}}
+    edgy = _healthy()
+    edgy["pipeline"] = {"drains_total": 3, "dispatches_total": 60,
+                        "drain_rate": 0.05,
+                        "drains_by_reason": {"prefill": 2, "chunk": 1,
+                                             "fail": 0}}
+    clean = _healthy()
+    clean["pipeline"] = {"drains_total": 2, "dispatches_total": 400,
+                         "drain_rate": 0.0,
+                         "drains_by_reason": {"drain": 2}}
+    fleet = {
+        "backends": ["a:1", "b:2", "c:3"], "cooling_down": [], "draining": [],
+        "replicas": {
+            "a:1": {"cooling": False, "draining": False, "health": taxed},
+            "b:2": {"cooling": False, "draining": False, "health": edgy},
+            "c:3": {"cooling": False, "draining": False, "health": clean},
+        },
+    }
+    lines = tputop.render(fleet).splitlines()
+    row_a = next(ln for ln in lines if ln.startswith("a:1"))
+    assert "0.12 sp7 gd4" in row_a          # feature tax, reason-split
+    assert "pf" not in row_a                # zero-count reasons stay silent
+    row_b = next(ln for ln in lines if ln.startswith("b:2"))
+    assert "0.05 pf2 ch1" in row_b
+    assert " x" not in row_b.split("0.05")[1].split("  ")[0]
+    row_c = next(ln for ln in lines if ln.startswith("c:3"))
+    drain_i = tputop.COLUMNS.index("drain")
+    assert row_c.split()[drain_i] == "0.00"  # idle settles: untagged
+    assert "sp" not in row_c and "gd" not in row_c
+
+
 def test_render_mixed_version_fleet_na_capacity_cells():
     """A replica whose /healthz predates serving/capacity.py (rollout in
     progress) must render '-' capacity cells — not a KeyError — while a

@@ -33,6 +33,9 @@ HIGHER_BETTER = frozenset({
     "ragged_toks_per_s", "ceiling_toks_per_s", "pct_of_ceiling", "speedup",
     "warm_speedup", "aot_speedup", "prefix_hit_rate", "bubble_reduction_pct",
     "offered_rps", "completed_rps", "service_capacity_rps",
+    # mixed-feature A/B (BENCH_mixedfeat): feature traffic's throughput,
+    # its plain baseline, and the ratio the 10%-tax bound is asserted on
+    "plain_toks_per_s", "mixedfeat_toks_per_s", "mixedfeat_ratio",
 })
 # latencies, bubbles, ready times
 LOWER_BETTER = frozenset({
@@ -41,6 +44,7 @@ LOWER_BETTER = frozenset({
     "bubble_ms_per_step", "cold_ready_s", "warm_ready_s", "aot_ready_s",
     "dispatch_rtt_ms", "failover_first_success_ms", "latency_p50_ms",
     "latency_p95_ms", "shed_rate", "ragged_edge_drains",
+    "feature_drains", "edge_drains",
     # autoscale ramp (AUTOSCALE_BENCH.json "ramp" block): reaction time,
     # worst shed while the fleet caught up, non-429 failures during drain
     "time_to_first_scale_up_s", "peak_shed_rate", "drain_errors",

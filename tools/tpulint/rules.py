@@ -713,7 +713,8 @@ def r7_manifest_flags(project: Project) -> List[Finding]:
 # hide. The fetch helper is the one sanctioned block point.
 _R8_DISPATCH_FNS = {"_do_decode", "_decode_dispatch",
                     "_drain_decode_pipeline", "_decode_operands",
-                    "_mixed_dispatch", "_advance_chunk_mixed"}
+                    "_mixed_dispatch", "_advance_chunk_mixed",
+                    "_settle_inflight", "_allow_words", "_allow_row"}
 _R8_SANCTIONED_FNS = {"_decode_fetch"}
 _R8_BLOCKING_ATTRS = {"block_until_ready", "device_get"}
 
@@ -722,8 +723,10 @@ _R8_BLOCKING_ATTRS = {"block_until_ready", "device_get"}
 def r8_decode_blocking(project: Project) -> List[Finding]:
     """Inside the decode dispatch-path functions (``_do_decode``,
     ``_decode_dispatch``, ``_drain_decode_pipeline``, ``_decode_operands``,
-    and the ragged mixed path's ``_mixed_dispatch`` /
-    ``_advance_chunk_mixed``) in serving/, any host-blocking device read — ``np.asarray(...)``,
+    the ragged mixed path's ``_mixed_dispatch`` / ``_advance_chunk_mixed``,
+    and the feature-path plumbing ``_settle_inflight`` / ``_allow_words`` /
+    ``_allow_row`` — the guided-mask builders must UPLOAD asynchronously,
+    never read back) in serving/, any host-blocking device read — ``np.asarray(...)``,
     ``jax.device_get(...)``, ``<x>.block_until_ready()`` — is a finding:
     it re-serializes the one-deep pipeline and the bubble metric stops
     measuring anything. The deferred block point is ``_decode_fetch`` and

@@ -37,6 +37,13 @@ BURN_COL = COLUMNS.index("burn5m")
 # "burning exactly the budget" line; the page-now threshold is 14.4)
 BURN_WARN = 1.0
 
+# drain-reason abbreviations for the per-reason tags in the ``drain``
+# column (ISSUE 16: "the drain rate is ~0" is only diagnosable when the
+# residue says WHICH feature path still drains). Deliberate-shutdown
+# ("drain") drains are excluded — operator-initiated, not a tax.
+DRAIN_ABBREV = (("spec", "sp"), ("guided", "gd"), ("prefill", "pf"),
+                ("chunk", "ch"), ("fail", "x"))
+
 # utilization samples per replica kept for the ``sat`` sparkline (watch mode
 # feeds one per refresh; --once and routerless one-shots render a single tick)
 SPARK_WIDTH = 8
@@ -151,6 +158,16 @@ def _row(addr: str, ent: dict, hist=None) -> list:
     bub = h.get("decode_bubble_pct")
     pipe = h.get("pipeline")
     drain = pipe.get("drain_rate") if isinstance(pipe, dict) else None
+    # per-reason residue tags after the rate (rate stays the first token so
+    # scripts keyed on row.split() see the same cell): "0.12 sp3 gd1" says
+    # the spec and guided paths are still paying the fallback tax.
+    drain_tags = ""
+    if isinstance(pipe, dict):
+        by = pipe.get("drains_by_reason")
+        if isinstance(by, dict):
+            drain_tags = "".join(
+                f" {ab}{int(by[r])}" for r, ab in DRAIN_ABBREV
+                if by.get(r))
     dev = h.get("device") or {}
     mfu = dev.get("mfu")
     duty = dev.get("duty_cycle")
@@ -172,8 +189,10 @@ def _row(addr: str, ent: dict, hist=None) -> list:
             "-" if bub is None else f"{bub:.1f}",
             # pipeline drain rate (drains per dispatch; serving/metrics.py
             # PipelineMetrics): ~0 on the ragged mixed path, one per
-            # admission on the legacy path. Pre-ragged replicas render "-".
-            "-" if drain is None else f"{drain:.2f}",
+            # admission on the legacy path, tagged with per-reason counts
+            # (DRAIN_ABBREV) so a nonzero rate names the offending feature
+            # path. Pre-ragged replicas render "-".
+            "-" if drain is None else f"{drain:.2f}" + drain_tags,
             _hbm_bar(dev),
             "-" if mfu is None else f"{mfu:.2f}",
             "-" if duty is None else f"{100.0 * duty:.0f}",
