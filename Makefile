@@ -8,7 +8,7 @@ PY ?= python
 	overload-smoke resume-smoke reconcile-smoke trace-smoke lint \
 	locksan-smoke aot-smoke pipeline-smoke ragged-smoke flight-smoke \
 	devmon-smoke capacity-smoke bench-diff bench-ragged bench-mixedfeat \
-	autoscale-smoke
+	bench-prefixtier autoscale-smoke
 
 # The tier-1 gate's shape (serial, CPU, slow tests excluded).
 test:
@@ -149,6 +149,12 @@ bench-ragged:
 # zero feature-reason pipeline drains. Writes BENCH_mixedfeat_r01.json.
 bench-mixedfeat:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --mixed-features
+
+# Warm-host-tier TTFT vs cold-re-prefill A/B (ISSUE 20): after LRU eviction
+# spills a long prompt's prefix pages to host RAM, re-serving it must beat
+# a full re-prefill by >= 3x TTFT. Writes BENCH_prefixtier_r01.json.
+bench-prefixtier:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --prefix-tier
 
 # AOT registry smoke (serving/aot.py): deviceless host-platform compile of
 # the full tiny-config program set through build_manifest — manifest schema

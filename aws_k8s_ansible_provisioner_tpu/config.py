@@ -653,6 +653,17 @@ class ServingConfig:
     # pool runs dry mid-decode the engine preempts the newest request
     # (vLLM-style recompute) rather than failing.
     kv_pool_pages: int = 0
+    # Tier-2 KV (ISSUE 20): byte budget for the host-RAM prefix-page store.
+    # When the HBM LRU reclaims an evictable page, its per-layer K/V spills
+    # here (async gather, off the dispatch hot path) keyed by the same chain
+    # hash; a later prompt whose prefix walks past the resident pages
+    # restores the host extension with one batched device_put and prefills
+    # only the suffix — eviction stops meaning re-prefill. Restore is
+    # PCIe-bandwidth-bound, far cheaper than recomputing prefill FLOPs
+    # (arxiv 2504.11816); fixed page shapes keep the transfer path static
+    # (SnapStream, arxiv 2511.03092). 0 disables the tier entirely — the
+    # byte-identity escape hatch (streams identical to a tier-less build).
+    kv_host_tier_bytes: int = 256 * 2**20
     # Batched prefill: up to this many queued prompts share one prefill
     # dispatch (rounded to a power-of-two row count so XLA compiles a fixed
     # set of programs). Under a burst, TTFT p50 scales with ceil(N/batch)
@@ -955,6 +966,10 @@ def ansible_vars(cfg: FrameworkConfig | None = None,
     # --ragged-attention so a fleet can A/B the one-program mixed path
     # against the legacy serialized chunk walk.
     d["serving_ragged_attention"] = cfg.serving.ragged_attention
+    # Tier-2 KV host-RAM budget (ISSUE 20): threaded to
+    # --kv-host-tier-bytes so a fleet can size (or zero out) the host
+    # prefix-page store per pod shape from the same single source.
+    d["serving_kv_host_tier_bytes"] = cfg.serving.kv_host_tier_bytes
     # Robustness knobs (r7): the manifests pass these to the engine CLI so
     # the deadline/admission behavior is deploy-configurable from the same
     # single source.

@@ -421,6 +421,14 @@ def build_ledger(plan, mesh, params, cache, entries,
         "total_bytes": total,
         "headroom_bytes": capacity - total,
         "fit": total <= capacity,
+        # Tier-2 KV (ISSUE 20): the host-RAM prefix-page budget the engine
+        # will pin. Informational — host DRAM, NOT counted against the HBM
+        # capacity above — but part of the fit story: a pod spec must
+        # reserve it on top of the process's baseline RSS. Absent from
+        # LEDGER_FIELDS so pre-tier manifests still verify.
+        "host_tier_bytes": int(getattr(plan.serving,
+                                       "kv_host_tier_bytes", 0))
+        if plan.paged else 0,
     }
 
 

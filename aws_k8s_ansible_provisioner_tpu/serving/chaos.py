@@ -54,6 +54,16 @@ fault                       defined degradation behavior
                             teardown (slots/pages released exactly once) and
                             the engine keeps serving — the feature paths
                             inherit the pipeline's failure contract
+``kv_offload_error``        a host-tier KV entry about to be restored is
+                            corrupted (truncated payload — a bad PCIe copy
+                            or host-RAM bit rot stand-in): the engine's
+                            fetch-time verification drops the entry, the
+                            restorable extension truncates there and the
+                            span re-prefills — tokens are never wrong, the
+                            drop is counted
+                            (``tpu_serve_kv_restore_dropped_total``).
+                            ``entries`` caps how many of the chain's
+                            entries are corrupted per firing (default all)
 ``span_export``             the OTLP trace collector misbehaves — refuses
                             connections, hangs, or answers 5xx (``mode``) —
                             only the exporter's background thread sees it:
@@ -124,7 +134,7 @@ FAULTS = ("connect_refused", "stalled_decode", "page_exhaustion",
           "slow_client", "mid_stream_disconnect", "kill_stream",
           "stream_read_error", "span_export", "pipeline_fetch_error",
           "ragged_dispatch_error", "ragged_feature_error",
-          "flight_dump_error",
+          "flight_dump_error", "kv_offload_error",
           "capacity_export_error", "autoscale_launch_error",
           "autoscale_drain_stuck")
 
@@ -302,6 +312,22 @@ class ChaosController:
             return
         raise InjectedFault(
             f"chaos: injected ragged feature-path failure ({kind})")
+
+    def on_kv_restore(self, tier, host_keys) -> None:
+        """engine._host_entries, before the host-tier payloads of a restore
+        are fetched: an armed ``kv_offload_error`` truncates the entries'
+        payloads in place (HostTier.corrupt) — standing in for a bad PCIe
+        copy or host-RAM corruption discovered only at restore time. The
+        engine's fetch-time shape verification then drops the entries and
+        re-prefills the span: degraded latency, never wrong tokens.
+        ``entries`` caps how many of the chain's entries are corrupted per
+        firing (default: all of them)."""
+        p = self.fire("kv_offload_error")
+        if p is None:
+            return
+        n = int(p.get("entries", len(host_keys)))
+        for key in list(host_keys)[:max(0, n)]:
+            tier.corrupt(key)
 
     def on_engine_step(self, engine) -> None:
         """engine.step entry: an armed ``page_exhaustion`` makes the page

@@ -55,7 +55,7 @@ DEFAULT_HBM_TOLERANCE_MB = 64.0
 # Program kinds the engine reports — the label set is closed so the gauge
 # cardinality is bounded no matter what traffic does.
 PROGRAM_KINDS = ("prefill", "prefill_batch", "prefill_chunk", "prefix_copy",
-                 "decode", "spec_decode", "mixed_step")
+                 "kv_restore", "decode", "spec_decode", "mixed_step")
 
 
 class DevMonMetrics:
@@ -155,9 +155,15 @@ class CostModel:
         prefill-like: weights stream once; each prompt token writes its KV
         row (attention reads ride the same rows and stay sub-dominant).
         prefix_copy: pure DMA — read + write of the copied rows, zero flops.
+        kv_restore: host-tier restore (ISSUE 20) — one HBM write per
+        restored KV row, zero flops. Its bandwidth-sense MFU column is the
+        restore-vs-reprefill ledger: the same tokens through a prefill kind
+        would have cost flops_per_token * tokens of MXU work.
         """
         if kind == "prefix_copy":
             return 0.0, 2.0 * tokens * self.kv_row_bytes
+        if kind == "kv_restore":
+            return 0.0, float(tokens) * self.kv_row_bytes
         flops = self.flops_per_token * tokens
         # Guided rows upload one allow-bitset row per step (the one-ahead
         # async upload ISSUE 16 added); pure extra HBM traffic, zero flops.

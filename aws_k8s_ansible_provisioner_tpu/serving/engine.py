@@ -39,10 +39,12 @@ from dataclasses import dataclass, field
 from typing import Deque, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from aws_k8s_ansible_provisioner_tpu.config import ModelConfig, ServingConfig
 from aws_k8s_ansible_provisioner_tpu.serving import capacity as _capacity
+from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
 from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
 from aws_k8s_ansible_provisioner_tpu.serving import devmon as _devmon
 from aws_k8s_ansible_provisioner_tpu.serving import flightrec as _flight
@@ -247,6 +249,7 @@ class Engine(EnginePrograms):
         "_inflight", "_pipe_carry", "_carry_gen", "_op_cache",
         "_op_dirty_sampling", "_op_dirty_table", "_last_ready",
         "_busy_watermark", "_allow_dev", "_allow_batch_dev",
+        "_restore_pending",
     )
 
     def __init__(self, cfg: ModelConfig, params, serving: ServingConfig,
@@ -450,6 +453,12 @@ class Engine(EnginePrograms):
                 total = sum(s["pages_total"] for s in sts) or 1
                 live = sum(s["pages_live"] for s in sts)
                 comp["kv_pages"] = cache_bytes * (live / total)
+                # evictable pages hold reusable prefixes but yield to the
+                # allocator on demand — ledger them as their own component
+                # so "pool full" and "pool full of reclaimable prefixes"
+                # read differently (ISSUE 20 satellite)
+                evict = sum(s["pages_evictable"] for s in sts)
+                comp["kv_pages_evictable"] = cache_bytes * (evict / total)
             else:
                 comp["kv_cache"] = float(cache_bytes)
             carry = self._pipe_carry
@@ -621,6 +630,11 @@ class Engine(EnginePrograms):
         the isolation-only gate left affinity-routed conversation load at a
         ~12% hit rate because bursts never consulted the index).
         """
+        if self.host_tier is not None:
+            # land spill copies issued on earlier steps (the
+            # copy_to_host_async has normally completed by now), releasing
+            # their staging HBM before this admission allocates
+            self.host_tier.flush_to_host()
         ctx = self._resume_ctx.get(req.id)
         resumed = ctx is not None
         ids = list(ctx) if resumed else list(req.prompt_ids)
@@ -628,19 +642,27 @@ class Engine(EnginePrograms):
         allocator = self._alloc(slot)
         matched: List[int] = []
         n = 0
+        host_keys: List[tuple] = []
         if self.serving.prefix_cache and req.prompt_logprobs is None:
             req_lidx = (self.lora_names.index(req.lora) + 1
                         if req.lora is not None else 0)
-            matched, n = allocator.lookup_prefix(
+            matched, n, host_keys = allocator.lookup_prefix(
                 ids, salt=self._lora_salt(req_lidx))
             # the final token must run through prefill to produce the first
             # sampled token — cap reuse one token short of the prompt
+            while host_keys and n + len(host_keys) * ps > len(ids) - 1:
+                host_keys.pop()
             while n > len(ids) - 1:
                 matched.pop()
                 n -= ps
+            # host-restorable pages count toward the burst-economics gate:
+            # a restore replaces the same prefill compute a resident share
+            # does, at PCIe cost instead of zero
             if not (isolated or resumed or self._should_chunk(req)
-                    or n >= ps * max(1, self.serving.prefix_reuse_min_pages)):
-                matched, n = [], 0
+                    or n + len(host_keys) * ps
+                    >= ps * max(1, self.serving.prefix_reuse_min_pages)):
+                matched, n, host_keys = [], 0, []
+        restore = self._host_entries(allocator, ids, n, host_keys)
         for pid in matched:
             allocator.retain(pid)
         need = -(-len(ids) // ps) - len(matched)
@@ -648,6 +670,10 @@ class Engine(EnginePrograms):
         if fresh is None:
             allocator.release_all(matched)
             return None
+        # gather any content this alloc just reclaimed BEFORE the restore
+        # scatter (or the upcoming prefill) can overwrite those pages —
+        # enqueue order is what makes the spill read pre-reclaim bytes
+        self._spill_reclaimed(slot)
         self._resume_ctx.pop(req.id, None)
         pages = matched + list(fresh)
         self._slot_pages[slot] = pages
@@ -657,11 +683,110 @@ class Engine(EnginePrograms):
             np.asarray(pages, np.int32) + self._gbase(slot)
         self._seq_counter += 1
         self._admit_seq[slot] = self._seq_counter
-        if n > 0:
+        off = n
+        if restore:
+            # the restored span begins at the first fresh page: pages[p] for
+            # p in [len(matched), len(matched)+len(restore)) — exactly the
+            # logical positions the host chain extends
+            self._schedule_restore(slot, fresh[:len(restore)], restore)
+            off = n + len(restore) * ps
+        if off > 0:
             self.metrics.prefix_cache_hits.inc()
-            self.metrics.prefix_tokens_reused.inc(n)
+            self.metrics.prefix_tokens_reused.inc(off)
+        self.metrics.prefix_tier_hits.inc(
+            tier="host" if restore else ("hbm" if n > 0 else "miss"))
         self._pages_gauges()
-        return ids, n, resumed
+        return ids, off, resumed
+
+    def _host_entries(self, allocator, ids: List[int], n: int,
+                      host_keys: List[tuple]) -> List[dict]:
+        """Fetch + verify the host-tier payloads extending a resident match.
+
+        Walks ``host_keys`` in chain order, verifying each entry's tokens and
+        per-leaf shapes against the pool's layout. The first failure —
+        corrupted/truncated payload (chaos ``kv_offload_error``) or an entry
+        that raced away — truncates the restorable extension there: the
+        suffix re-prefills, tokens are never wrong (drop, not corrupt).
+        """
+        tier = allocator.host_tier
+        if tier is None or not host_keys:
+            return []
+        ch = _chaos.get()
+        if ch.enabled:
+            ch.on_kv_restore(tier, host_keys)
+        ps = self.serving.page_size
+        entries: List[dict] = []
+        p0 = n // ps
+        for i, key in enumerate(host_keys):
+            toks = tuple(ids[(p0 + i) * ps:(p0 + i + 1) * ps])
+            data = tier.fetch(key, toks, self._page_shapes)
+            if data is None:
+                self.metrics.kv_restore_dropped.inc()
+                break
+            entries.append(data)
+        return entries
+
+    def _schedule_restore(self, slot: int, pids: List[int],
+                          entries: List[dict]):
+        """Enqueue the batched host->HBM restore of spilled pages into the
+        slot's freshly allocated pages. Async-only (tpulint R8): stacks the
+        payloads per leaf, device-puts them and scatters in place (donated
+        pool, same per-page layout as write_prompts_paged_layer). XLA data
+        dependencies order the scatter ahead of every later program reading
+        these pages — nothing blocks here and no pipeline drains; timing and
+        byte accounting settle in _settle_restore at chunk start."""
+        gbase = self._gbase(slot)
+        data = {name: jnp.stack([e[name] for e in entries], axis=1)
+                for name in entries[0]}
+        self.cache = pkv.restore_pages(
+            self.cache, [int(p) + gbase for p in pids], data)
+        nbytes = len(entries) * self._page_bytes
+        self._alloc(slot).host_tier.note_restored(len(entries), nbytes)
+        self._restore_pending[slot] = {
+            "pages": len(entries),
+            "tokens": len(entries) * self.serving.page_size,
+            "bytes": nbytes, "t0": time.monotonic()}
+
+    def _settle_restore(self, slot: int):
+        """Settle a scheduled restore before the slot's first suffix chunk:
+        the paged analogue of the dense prefix-copy sync. The block is
+        sanctioned (R8) — the wait IS the PCIe DMA this feature trades for
+        the prefix re-prefill FLOPs, and devmon's kv_restore cost term needs
+        the real wall time."""
+        pend = self._restore_pending.pop(slot, None)
+        if pend is None:
+            return
+        jax.block_until_ready(self.cache["k"])
+        dt = time.monotonic() - pend["t0"]
+        _devmon.note("kv_restore", dt, tokens=pend["tokens"])
+        self.metrics.kv_restore_bytes.inc(pend["bytes"])
+        if self.host_tier is not None:
+            self.host_tier.flush_to_host()
+
+    def _spill_reclaimed(self, slot: int):
+        """Drain the slot's allocator reclaim log into the host tier:
+        one batched device-side gather per burst, per-page slices handed to
+        the tier with their PCIe copy started. Async-only (tpulint R8) —
+        runs right after the allocation that reclaimed the pages, on the
+        admission/growth path, and never blocks; the numpy conversion
+        happens later in HostTier.flush_to_host at a sanctioned point."""
+        allocator = self._alloc(slot)
+        tier = allocator.host_tier
+        log = allocator.evicted_log
+        if tier is None or not log:
+            return
+        allocator.evicted_log = []
+        gbase = self._gbase(slot)
+        data = pkv.gather_pages(self.cache,
+                                [pid + gbase for pid, _, _ in log])
+        for i, (_, key, toks) in enumerate(log):
+            entry = {name: arr[:, i] for name, arr in data.items()}
+            for a in entry.values():
+                start = getattr(a, "copy_to_host_async", None)
+                if start is not None:
+                    start()
+            tier.put(key, toks, entry, self._page_bytes)
+        self.metrics.kv_spill_bytes.inc(len(log) * self._page_bytes)
 
     def _index_prompt_pages(self, slot: int, ids: List[int],
                             n_valid: Optional[int] = None):
@@ -695,6 +820,10 @@ class Engine(EnginePrograms):
             return
         self._alloc(slot).release_all(self._slot_pages[slot])
         self._slot_pages[slot] = []
+        # a restore scheduled for a slot torn down before its chunk started
+        # (deadline/cancel between admission and dispatch) must not settle
+        # against a later tenant's chunk
+        self._restore_pending.pop(slot, None)
         self._op_dirty_table = True
         self.table[slot, :] = self._scratch[slot]
         self.lengths[slot] = 0
@@ -704,6 +833,13 @@ class Engine(EnginePrograms):
         sts = [a.stats() for a in self.allocators]
         self.metrics.kv_pages_total.set(sum(s["pages_total"] for s in sts))
         self.metrics.kv_pages_in_use.set(sum(s["pages_live"] for s in sts))
+        self.metrics.kv_pages_free.set(sum(s["pages_free"] for s in sts))
+        self.metrics.kv_pages_evictable.set(
+            sum(s["pages_evictable"] for s in sts))
+        if self.host_tier is not None:
+            self.metrics.kv_host_tier_used_bytes.set(
+                self.host_tier.used_bytes)
+            self.metrics.kv_host_tier_entries.set(len(self.host_tier))
 
     def _ensure_pages(self, new_rows: int) -> bool:
         """Grow every active slot's page run to cover rows
@@ -730,6 +866,10 @@ class Engine(EnginePrograms):
                 need = -(-rows // ps) - len(pages)
                 got = self._alloc(slot).alloc(need)
                 if got is not None:
+                    # spill whatever this alloc reclaimed before the decode
+                    # dispatch can write the pages (async gather only —
+                    # this is the hot path)
+                    self._spill_reclaimed(slot)
                     self._op_dirty_table = True
                     self.table[slot, len(pages):len(pages) + need] = \
                         np.asarray(got, np.int32) + self._gbase(slot)

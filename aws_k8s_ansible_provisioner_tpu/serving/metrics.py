@@ -43,6 +43,12 @@ class Counter:
         with self._lock:
             return sum(self._values.values())
 
+    def value(self, **labels) -> float:
+        """One label combination's count (/healthz tier splits, tests)."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
     def collect(self, openmetrics: bool = False) -> List[str]:
         # OpenMetrics names the counter FAMILY without the _total suffix
         # (samples keep it); classic text uses the full name everywhere.
@@ -265,6 +271,40 @@ class EngineMetrics:
         self.kv_pages_in_use = r.register(Gauge(
             "tpu_serve_kv_pages_in_use",
             "KV pages currently referenced by live requests"))
+        # Free/evictable split (ISSUE 20 satellite): "pool full" and "pool
+        # full of reusable prefixes" are different capacity situations —
+        # evictable pages reclaim on demand but still serve prefix hits.
+        self.kv_pages_free = r.register(Gauge(
+            "tpu_serve_kv_pages_free",
+            "KV pages on the free list (content meaningless)"))
+        self.kv_pages_evictable = r.register(Gauge(
+            "tpu_serve_kv_pages_evictable",
+            "Refcount-zero KV pages retained for prefix reuse "
+            "(reclaimable on demand)"))
+        # Tier-2 KV (host-RAM prefix-page store, ISSUE 20): where each
+        # admission's prefix lookup resolved, and the PCIe traffic the tier
+        # moves. restore_bytes replaces re-prefill FLOPs; dropped counts
+        # corrupted/truncated entries that fell back to re-prefill.
+        self.prefix_tier_hits = r.register(Counter(
+            "tpu_serve_prefix_tier_hits_total",
+            "Paged admissions by prefix-lookup outcome tier",
+            ("tier",)))
+        self.kv_spill_bytes = r.register(Counter(
+            "tpu_serve_kv_spill_bytes_total",
+            "KV bytes spilled from reclaimed HBM pages to the host tier"))
+        self.kv_restore_bytes = r.register(Counter(
+            "tpu_serve_kv_restore_bytes_total",
+            "KV bytes restored from the host tier instead of re-prefilled"))
+        self.kv_restore_dropped = r.register(Counter(
+            "tpu_serve_kv_restore_dropped_total",
+            "Host-tier entries dropped at restore (corrupt/truncated/raced "
+            "away; the span re-prefilled instead)"))
+        self.kv_host_tier_used_bytes = r.register(Gauge(
+            "tpu_serve_kv_host_tier_used_bytes",
+            "Bytes of spilled KV pages resident in the host tier"))
+        self.kv_host_tier_entries = r.register(Gauge(
+            "tpu_serve_kv_host_tier_entries",
+            "Spilled KV pages resident in the host tier"))
         # Batch-block size the decode kernels run with (autotuned at engine
         # start per (batch, page_size, kv_dtype) — see
         # Engine._resolve_decode_bblock). A dashboard seeing 1 on a TPU pod

@@ -38,6 +38,7 @@ always legal).
 from __future__ import annotations
 
 import collections
+import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -265,6 +266,180 @@ def gather_dense(pool: dict, table: jnp.ndarray, page_size: int) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Host tier (tier-2 KV): spill/restore of whole pages across PCIe
+# ---------------------------------------------------------------------------
+
+
+def gather_pages(pool: dict, pages: Sequence[int]) -> dict:
+    """Enqueue a device-side gather of whole physical pages for spilling.
+
+    pool: FULL-pool leaves ``[L, P, ...]``; pages: global physical ids.
+    Returns ``{name: [L, k, Hkv, page, (D)]}`` — eager jnp ops only, so this
+    just enqueues device work without blocking the dispatch thread (R8-safe);
+    the actual PCIe copy is started with ``copy_to_host_async`` and settled
+    lazily by :meth:`HostTier.flush_to_host` at the next sanctioned block
+    point. The gather is enqueued BEFORE any program that overwrites the
+    reclaimed pages, so XLA's data-dependency ordering guarantees it reads
+    the pre-reclaim content.
+    """
+    idx = jnp.asarray(list(pages), jnp.int32)
+    return {name: jnp.take(arr, idx, axis=1) for name, arr in pool.items()}
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _restore_scatter(pool: dict, pages: jnp.ndarray, data: dict) -> dict:
+    return {name: arr.at[:, pages].set(data[name], mode="drop")
+            for name, arr in pool.items()}
+
+
+def restore_pages(pool: dict, pages: Sequence[int], data: dict) -> dict:
+    """Scatter host-tier page payloads back into freshly allocated pages.
+
+    pool: FULL-pool leaves (donated — the scatter is in place, no second
+    pool-sized buffer); pages: global physical ids; data: ``{name:
+    [L, k, Hkv, page, (D)]}`` stacked page payloads in the same per-page
+    layout ``write_prompts_paged_layer`` produces. The page axis is padded to
+    the next power of two with ``OOB_PAGE`` ids (dropped by the scatter) so
+    restore bursts of any size hit a log-bounded set of compiled programs.
+    """
+    k = len(pages)
+    width = 1
+    while width < k:
+        width *= 2
+    pg = np.full(width, OOB_PAGE, np.int32)
+    pg[:k] = list(pages)
+    padded = {}
+    for name, arr in data.items():
+        if arr.shape[1] != width:
+            pad = [(0, 0)] * arr.ndim
+            pad[1] = (0, width - arr.shape[1])
+            arr = jnp.pad(jnp.asarray(arr), pad)
+        padded[name] = jnp.asarray(arr)
+    return _restore_scatter(pool, jnp.asarray(pg), padded)
+
+
+class HostTier:
+    """Byte-budgeted host-RAM store of spilled KV pages, keyed by chain hash.
+
+    Tier-2 of the cache hierarchy: when the HBM LRU reclaims an evictable
+    page, the engine gathers its per-layer K/V and parks it here; a later
+    prompt whose prefix chain walks past the resident pages can restore the
+    host extension with a batched ``device_put`` instead of re-prefilling
+    (arxiv 2504.11816: restore is bandwidth-bound and far cheaper than
+    recompute). Entries are whole fixed-shape pages — the transfer path is
+    static (SnapStream, arxiv 2511.03092) and rides the existing page layout.
+
+    Entry data values start life as device arrays (the async gather's
+    output) with ``copy_to_host_async`` already issued; ``flush_to_host``
+    converts them to numpy at the next sanctioned block point, releasing the
+    HBM. Eviction is LRU by bytes. Content is verified on fetch: token
+    mismatch, wrong shapes/dtypes, or truncation (chaos ``kv_offload_error``)
+    drop the entry — the caller falls back to re-prefill, never to wrong
+    tokens.
+    """
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes <= 0:
+            raise ValueError("HostTier needs a positive byte budget")
+        self.budget_bytes = int(budget_bytes)
+        self.used_bytes = 0
+        # chain key -> {"tokens": tuple, "data": {name: array}, "nbytes": int}
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self._unflushed: List[Tuple] = []     # keys whose data is on-device
+        self.spilled_pages = 0
+        self.spilled_bytes = 0
+        self.restored_pages = 0
+        self.restored_bytes = 0
+        self.dropped_lru = 0        # evicted by byte pressure
+        self.dropped_invalid = 0    # failed verification on fetch
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def put(self, key: Tuple, tokens: Tuple, data: dict, nbytes: int):
+        """Insert/refresh one spilled page; evicts LRU entries over budget."""
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self.used_bytes -= old["nbytes"]
+        self._entries[key] = {"tokens": tokens, "data": data,
+                              "nbytes": int(nbytes)}
+        self._unflushed.append(key)
+        self.used_bytes += int(nbytes)
+        self.spilled_pages += 1
+        self.spilled_bytes += int(nbytes)
+        while self.used_bytes > self.budget_bytes and self._entries:
+            _, dropped = self._entries.popitem(last=False)   # LRU front
+            self.used_bytes -= dropped["nbytes"]
+            self.dropped_lru += 1
+
+    def contains(self, key: Tuple, tokens: Tuple) -> bool:
+        """Cheap membership + token verification (no LRU bump, no payload
+        checks — :meth:`fetch` is the authority at restore time)."""
+        e = self._entries.get(key)
+        return e is not None and e["tokens"] == tokens
+
+    def fetch(self, key: Tuple, tokens: Tuple,
+              shapes: Dict[str, Tuple]) -> Optional[dict]:
+        """Return a verified entry's payload (LRU-bumped), or None.
+
+        ``shapes`` maps leaf name -> expected per-page shape
+        ``[L, Hkv, page, (D)]``. A corrupted or truncated entry (chaos
+        ``kv_offload_error``) fails the shape check, is dropped from the
+        tier, and the caller re-prefills that span — drop, never corrupt.
+        """
+        e = self._entries.get(key)
+        if e is None:
+            return None
+        data = e["data"]
+        ok = (e["tokens"] == tokens
+              and set(data.keys()) == set(shapes.keys())
+              and all(tuple(data[n].shape) == tuple(shapes[n])
+                      for n in shapes))
+        if not ok:
+            del self._entries[key]
+            self.used_bytes -= e["nbytes"]
+            self.dropped_invalid += 1
+            return None
+        self._entries.move_to_end(key)
+        return data
+
+    def note_restored(self, pages: int, nbytes: int):
+        self.restored_pages += pages
+        self.restored_bytes += nbytes
+
+    def corrupt(self, key: Tuple):
+        """Chaos hook (``kv_offload_error``): truncate an entry's payload in
+        place so the next :meth:`fetch` fails verification and drops it."""
+        e = self._entries.get(key)
+        if e is not None:
+            e["data"] = {n: a[:-1] for n, a in e["data"].items()}
+
+    def flush_to_host(self):
+        """Convert device-resident payloads to numpy, releasing their HBM.
+        Called from sanctioned block points only — the ``copy_to_host_async``
+        issued at spill time has normally landed by now, making this cheap."""
+        for key in self._unflushed:
+            e = self._entries.get(key)
+            if e is None:
+                continue
+            e["data"] = {n: np.asarray(a) for n, a in e["data"].items()}
+        self._unflushed = []
+
+    def stats(self) -> dict:
+        return {
+            "budget_bytes": self.budget_bytes,
+            "used_bytes": self.used_bytes,
+            "entries": len(self._entries),
+            "spilled_pages": self.spilled_pages,
+            "spilled_bytes": self.spilled_bytes,
+            "restored_pages": self.restored_pages,
+            "restored_bytes": self.restored_bytes,
+            "dropped_lru": self.dropped_lru,
+            "dropped_invalid": self.dropped_invalid,
+        }
+
+
+# ---------------------------------------------------------------------------
 # Host allocator
 # ---------------------------------------------------------------------------
 
@@ -316,6 +491,14 @@ class PagePool:
         self._hash_to_page: Dict[Tuple, int] = {}
         # LRU of evictable pages: OrderedDict page_id -> None
         self._evictable: collections.OrderedDict = collections.OrderedDict()
+        # Tier-2 spill plumbing (engine-owned). When a HostTier is attached,
+        # every hash-indexed page the LRU reclaims is recorded here as
+        # (local_pid, chain_key, tokens); the ENGINE drains the log right
+        # after the allocation burst — before any program can overwrite the
+        # page — gathers the content and parks it in the tier. The pool
+        # itself never touches the device.
+        self.host_tier: Optional["HostTier"] = None
+        self.evicted_log: List[Tuple[int, Tuple, Tuple]] = []
 
     # -- capacity ----------------------------------------------------------
 
@@ -335,6 +518,9 @@ class PagePool:
             return self._free.popleft()
         if self._evictable:
             pid, _ = self._evictable.popitem(last=False)   # LRU front
+            if self.host_tier is not None and pid in self._page_key:
+                key, toks = self._page_key[pid]
+                self.evicted_log.append((pid, key, toks))
             self._drop_index(pid)
             return pid
         return None
@@ -399,14 +585,19 @@ class PagePool:
         return key
 
     def lookup_prefix(self, prompt: Sequence[int],
-                      salt=None) -> Tuple[List[int], int]:
-        """Longest chain of resident FULL pages matching the prompt's prefix.
+                      salt=None) -> Tuple[List[int], int, List[Tuple]]:
+        """Two-level longest-prefix match: resident chain + host extension.
 
-        Returns (page_ids, n_tokens). Walks page-by-page — O(n_pages) hash
-        probes with token verification, independent of slot count (VERDICT r2
-        weak #5). Only complete pages match; the caller re-prefills the tail.
-        Matched pages are NOT retained — callers must ``retain`` each page
-        they actually use before any other allocation can evict it.
+        Returns ``(page_ids, n_tokens, host_keys)``. Walks page-by-page —
+        O(n_pages) hash probes with token verification, independent of slot
+        count (VERDICT r2 weak #5). Only complete pages match; the caller
+        re-prefills the tail. ``host_keys`` continues the chain walk into the
+        attached :class:`HostTier` (empty without one): the chain keys of
+        host-restorable pages extending the resident match, in prefix order —
+        the engine restores those into fresh pages so the chunk program
+        prefills only the suffix past the restored frontier. Matched resident
+        pages are NOT retained — callers must ``retain`` each page they
+        actually use before any other allocation can evict it.
 
         ``salt`` seeds the hash chain: pages written under different salts
         (e.g. different LoRA adapters — their K/V projections differ even
@@ -415,7 +606,9 @@ class PagePool:
         ps = self.page_size
         pages: List[int] = []
         parent = salt
-        for p in range(len(prompt) // ps):
+        full = len(prompt) // ps
+        p = 0
+        while p < full:
             toks = tuple(prompt[p * ps:(p + 1) * ps])
             key = self.chain_key(parent, toks)
             pid = self._hash_to_page.get(key)
@@ -423,12 +616,26 @@ class PagePool:
                 break
             pages.append(pid)
             parent = key
-        return pages, len(pages) * ps
+            p += 1
+        host: List[Tuple] = []
+        if self.host_tier is not None:
+            while p < full:
+                toks = tuple(prompt[p * ps:(p + 1) * ps])
+                key = self.chain_key(parent, toks)
+                if not self.host_tier.contains(key, toks):
+                    break
+                host.append(key)
+                parent = key
+                p += 1
+        return pages, len(pages) * ps, host
 
     def stats(self) -> dict:
-        return {
+        out = {
             "pages_total": self.num_pages - self.first_page,
             "pages_free": len(self._free),
             "pages_evictable": len(self._evictable),
             "pages_live": int((self._ref > 0).sum()),
         }
+        if self.host_tier is not None:
+            out["host_tier"] = self.host_tier.stats()
+        return out

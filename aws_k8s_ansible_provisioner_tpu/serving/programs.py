@@ -984,6 +984,9 @@ class EnginePrograms:
                     f"vocab ({cfg.vocab_size}) — drafts are target token ids")
             self.draft = DraftModel(dcfg, dparams, self.num_slots,
                                     self.max_len, dtype)
+        # Tier-2 host store handle (paged mode only; None = tier off or
+        # dense layout). /healthz and the fit ledger read it.
+        self.host_tier = None
         if self.paged:
             from aws_k8s_ansible_provisioner_tpu.serving import paged_kv as pkv
 
@@ -1052,6 +1055,29 @@ class EnginePrograms:
             self.allocators = [pkv.PagePool(self._group_pages, ps,
                                             first_page=1)
                                for _ in range(self.dp_groups)]
+            # Tier-2 KV (ISSUE 20): ONE host-RAM store shared by every dp
+            # group's allocator — chain-hash keys are group-agnostic, so a
+            # prefix evicted from one group's partition can restore into any
+            # group's fresh pages. Budget 0 leaves the tier off entirely:
+            # no spill log, no host walk in lookup_prefix — the
+            # byte-identity escape hatch.
+            if serving.kv_host_tier_bytes > 0:
+                self.host_tier = pkv.HostTier(serving.kv_host_tier_bytes)
+                for a in self.allocators:
+                    a.host_tier = self.host_tier
+            # host metadata for spill/restore accounting (never touches the
+            # device): per-page payload bytes across all leaves, and each
+            # leaf's expected per-page shape [L, Hkv, page, (D)] — the
+            # fetch-time truncation check behind chaos kv_offload_error
+            self._page_bytes = sum(
+                cfg.num_layers * int(np.prod(arr.shape[2:]))
+                * arr.dtype.itemsize for arr in self.cache.values())
+            self._page_shapes = {
+                name: (cfg.num_layers,) + tuple(arr.shape[2:])
+                for name, arr in self.cache.items()}
+            # slot -> scheduled-but-unsettled restore record (timing +
+            # byte accounting; correctness rides XLA data dependencies)
+            self._restore_pending: dict = {}
             # per-slot global id of its group's scratch page (group 0's is 0,
             # preserving the single-device layout)
             self._scratch = np.repeat(
@@ -1511,6 +1537,12 @@ class EnginePrograms:
         if self.paged:
             _, ids, off, resumed = pref if pref is not None \
                 else ("paged", list(req.prompt_ids), 0, False)
+            # settle any scheduled host-tier restore before the first suffix
+            # chunk dispatch (the paged analogue of the dense prefix-copy
+            # sync below) — timing/byte accounting only; XLA data
+            # dependencies already order the restore scatter ahead of every
+            # program reading these pages
+            self._settle_restore(slot)
             self.lengths[slot] = off
             self._chunk = {"req": req, "slot": slot, "off": off,
                            "C": self._chunk_size, "ids": ids,

@@ -260,6 +260,26 @@ class BackendPool:
             while len(self._affinity) > AFFINITY_CAP:
                 self._affinity.popitem(last=False)
 
+    def migrate_affinity(self, src: str, dst: str) -> int:
+        """Bulk re-point every affinity entry on ``src`` to ``dst``
+        (ISSUE 20 satellite): when a replica leaves the pool its HBM prefix
+        index dies with it, but the FIRST re-hit on the new home rebuilds
+        the chain — and with the tier-2 host store the rebuilt pages
+        outlive HBM pressure there — so keeping the cohort together beats
+        scattering it over the pool and re-prefilling everywhere. LRU
+        positions are preserved (no move_to_end: a migration is not a use).
+        Returns the number of entries re-pointed."""
+        with self._lock:
+            return self._migrate_affinity_locked(src, dst)
+
+    def _migrate_affinity_locked(self, src: str, dst: str) -> int:
+        moved = 0
+        for key, a in self._affinity.items():
+            if a == src:
+                self._affinity[key] = dst
+                moved += 1
+        return moved
+
     def _score(self, addr: str, now: float):
         ent = self._load.get(addr)
         if ent is None or now - ent[1] > LOAD_TTL_S:
@@ -352,8 +372,25 @@ class BackendPool:
             if addr in self._static:
                 self._static.remove(addr)
             self._load.pop(addr, None)
-            self._affinity = collections.OrderedDict(
-                (k, a) for k, a in self._affinity.items() if a != addr)
+            # Re-point (not drop) the dead replica's affinity cohort to one
+            # surviving replica — least-loaded by fresh /load sample, else
+            # the first in rotation. The cohort's first re-hit there
+            # re-prefills once and re-seeds the prefix chain (HBM + host
+            # tier); dropping the entries instead would scatter the cohort
+            # and pay that rebuild on EVERY replica it lands on. No
+            # survivor → entries drop (nothing to point at).
+            now = time.monotonic()
+            survivors = [a for a in self._addrs
+                         if a not in self._dead and a not in self._draining] \
+                or self._addrs
+            if survivors:
+                dst = min(survivors,
+                          key=lambda a: (self._score(a, now) is None,
+                                         self._score(a, now) or 0.0))
+                self._migrate_affinity_locked(addr, dst)
+            else:
+                self._affinity = collections.OrderedDict(
+                    (k, a) for k, a in self._affinity.items() if a != addr)
             return present
 
     def note_draining(self, addr: str) -> bool:

@@ -443,6 +443,20 @@ class Handler(BaseHTTPRequestHandler):
                     round(eng.metrics.tokens_per_second.value(), 2),
                 "kv_pages_total": int(eng.metrics.kv_pages_total.value()),
                 "kv_pages_in_use": int(eng.metrics.kv_pages_in_use.value()),
+                # Free/evictable split + tier-2 ledger (ISSUE 20): "pool
+                # full" vs "pool full of reusable prefixes" are different
+                # capacity situations, and the tier split says where prefix
+                # hits are actually being served from (hbm share / host
+                # restore / miss) without a /metrics scrape+parse.
+                "kv_pages_free": int(eng.metrics.kv_pages_free.value()),
+                "kv_pages_evictable":
+                    int(eng.metrics.kv_pages_evictable.value()),
+                "prefix_tier_hits": {
+                    t: int(eng.metrics.prefix_tier_hits.value(tier=t))
+                    for t in ("hbm", "host", "miss")},
+                "kv_host_tier": (
+                    eng.host_tier.stats()
+                    if getattr(eng, "host_tier", None) is not None else None),
                 "slo": slo.get().snapshot(),
                 "slo_burning": slo.get().burning(),
                 "flight": flightrec.get().summary(),
@@ -1762,6 +1776,12 @@ def main(argv=None):
                         "the packed layout, and spec-decode verify hands "
                         "the carry off without draining. 0 restores the "
                         "per-feature sync fallback (byte-identity A/B arm)")
+    p.add_argument("--kv-host-tier-bytes", type=int, default=256 * 2**20,
+                   help="byte budget for the tier-2 host-RAM KV store: "
+                        "evicted prefix pages spill here and restore via "
+                        "one batched device_put instead of re-prefilling "
+                        "(paged mode only). 0 disables the tier — the "
+                        "byte-identity escape hatch")
     p.add_argument("--chat-template", default="",
                    help="path to a Jinja chat template file")
     p.add_argument("--platform", default="",
@@ -1915,6 +1935,7 @@ def main(argv=None):
         decode_pipeline=args.decode_pipeline,
         ragged_attention=args.ragged_attention,
         ragged_features=args.ragged_features,
+        kv_host_tier_bytes=args.kv_host_tier_bytes,
         checkpoint_dir=args.checkpoint_dir, chat_template=args.chat_template,
         prefill_chunk=args.prefill_chunk,
         prefix_cache=not args.no_prefix_cache,

@@ -101,16 +101,16 @@ def test_prefix_chain_lookup_and_eviction():
     key = None
     for i in range(2):                  # index the full pages
         key = p.index_page(pages[i], key, tuple(prompt[i * PS:(i + 1) * PS]))
-    hit, n = p.lookup_prefix(prompt)
+    hit, n, _ = p.lookup_prefix(prompt)
     assert hit == pages[:2] and n == 2 * PS
     # a different prompt sharing only page 0 matches one page
     other = prompt[:PS] + [99] * PS
-    hit2, n2 = p.lookup_prefix(other)
+    hit2, n2, _ = p.lookup_prefix(other)
     assert hit2 == pages[:1] and n2 == PS
     # release -> pages become evictable, still hit
     p.release_all(pages)
     assert p.free_pages == 6            # 3 free + 2 evictable + tail freed
-    hit3, n3 = p.lookup_prefix(prompt)
+    hit3, n3, _ = p.lookup_prefix(prompt)
     assert hit3 == hit and n3 == 2 * PS
     # retaining an evictable page revives it
     for pid in hit3:
@@ -334,3 +334,117 @@ def test_layer_writers_match_per_layer_forms(quant):
     for name in ref_l:
         np.testing.assert_array_equal(np.asarray(got[name][1]),
                                       np.asarray(ref_l[name]), err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# Tier-2 host store (ISSUE 20): spill log, two-level lookup, LRU byte
+# pressure, fetch-time verification, gather/restore round trip
+# ---------------------------------------------------------------------------
+
+
+def _entry_data(tokens, scale=1.0):
+    """Deterministic fake page payload keyed off its tokens."""
+    base = float(sum(tokens) % 97) * scale
+    return {"k": np.full((2, 2, PS, 16), base, np.float32),
+            "v": np.full((2, 2, PS, 16), -base, np.float32)}
+
+
+ENTRY_BYTES = 2 * 2 * 2 * PS * 16 * 4
+SHAPES = {"k": (2, 2, PS, 16), "v": (2, 2, PS, 16)}
+
+
+def test_host_tier_spill_log_and_two_level_lookup():
+    """Reclaiming an indexed page records it in evicted_log; once its
+    payload sits in the tier, lookup_prefix returns it as the host
+    extension past the resident chain."""
+    p = pkv.PagePool(4, PS, first_page=1)
+    tier = pkv.HostTier(10 * ENTRY_BYTES)
+    p.host_tier = tier
+    prompt = list(range(3 * PS))
+    pages = p.alloc(3)
+    key = None
+    keys = []
+    for i in range(3):
+        key = p.index_page(pages[i], key, tuple(prompt[i * PS:(i + 1) * PS]))
+        keys.append(key)
+    p.release_all(pages)
+    # reclaim the two LRU-front pages -> logged with their chain identity
+    p.alloc(2)
+    assert [(k, tuple(prompt[i * PS:(i + 1) * PS]))
+            for i, k in enumerate(keys[:2])] \
+        == [(k, t) for _, k, t in p.evicted_log]
+    # engine-side drain stand-in: park the payloads in the tier
+    for _, k, t in p.evicted_log:
+        tier.put(k, t, _entry_data(t), ENTRY_BYTES)
+    p.evicted_log = []
+    res, n, host = p.lookup_prefix(prompt)
+    # pages 0-1 restorable from host, page 2 still resident/evictable
+    assert n == 0 and res == [] and host == keys[:2]
+    # without the tier attached the host walk is off entirely
+    p.host_tier = None
+    assert p.lookup_prefix(prompt) == ([], 0, [])
+
+
+def test_host_tier_lru_under_byte_pressure():
+    tier = pkv.HostTier(2 * ENTRY_BYTES)
+    toks = [tuple(range(i * PS, (i + 1) * PS)) for i in range(3)]
+    keys = [pkv.PagePool.chain_key(None, t) for t in toks]
+    for k, t in zip(keys, toks):
+        tier.put(k, t, _entry_data(t), ENTRY_BYTES)
+    # third insert evicted the FIRST (LRU) entry, not the newest
+    assert len(tier) == 2 and tier.dropped_lru == 1
+    assert not tier.contains(keys[0], toks[0])
+    assert tier.contains(keys[1], toks[1])
+    assert tier.contains(keys[2], toks[2])
+    assert tier.used_bytes == 2 * ENTRY_BYTES
+    # a fetch bumps recency: entry 1 survives the next pressure insert
+    assert tier.fetch(keys[1], toks[1], SHAPES) is not None
+    t3 = tuple(range(90, 90 + PS))
+    k3 = pkv.PagePool.chain_key(None, t3)
+    tier.put(k3, t3, _entry_data(t3), ENTRY_BYTES)
+    assert tier.contains(keys[1], toks[1])
+    assert not tier.contains(keys[2], toks[2])
+
+
+def test_host_tier_fetch_verifies_and_drops():
+    """Corrupted (truncated) or token-mismatched entries never come back
+    from fetch — they are dropped and counted, so the caller re-prefills
+    instead of restoring garbage (the kv_offload_error contract)."""
+    tier = pkv.HostTier(10 * ENTRY_BYTES)
+    toks = tuple(range(PS))
+    key = pkv.PagePool.chain_key(None, toks)
+    tier.put(key, toks, _entry_data(toks), ENTRY_BYTES)
+    # token mismatch (hash collision stand-in)
+    assert tier.fetch(key, tuple(range(1, PS + 1)), SHAPES) is None
+    assert tier.dropped_invalid == 1 and len(tier) == 0
+    # truncation via the chaos hook
+    tier.put(key, toks, _entry_data(toks), ENTRY_BYTES)
+    tier.corrupt(key)
+    assert tier.fetch(key, toks, SHAPES) is None
+    assert tier.dropped_invalid == 2 and len(tier) == 0
+    assert tier.used_bytes == 0
+    # a clean entry still round-trips
+    tier.put(key, toks, _entry_data(toks), ENTRY_BYTES)
+    got = tier.fetch(key, toks, SHAPES)
+    np.testing.assert_array_equal(got["k"], _entry_data(toks)["k"])
+
+
+def test_gather_restore_roundtrip():
+    """gather_pages -> restore_pages moves whole pages losslessly into a
+    different set of physical pages (the spill->restore data path), and the
+    padded scatter touches nothing else."""
+    _, pool, _ = _identity_layout(perm_seed=3)
+    src, dst = [2, 5, 9], [11, 3, 7]
+    before = {n: np.asarray(a) for n, a in pool.items()}
+    data = pkv.gather_pages(pool, src)
+    for name in data:
+        assert data[name].shape[1] == 3
+    # the pool is DONATED (in-place scatter) — read expectations from the
+    # pre-restore snapshot, never the consumed buffers
+    restored = pkv.restore_pages(pool, dst, data)
+    for name in before:
+        got = np.asarray(restored[name])
+        np.testing.assert_array_equal(got[:, dst], before[name][:, src])
+        untouched = [p for p in range(before[name].shape[1]) if p not in dst]
+        np.testing.assert_array_equal(got[:, untouched],
+                                      before[name][:, untouched])

@@ -263,3 +263,140 @@ def test_prefix_hit_with_chunked_suffix(setup):
     got = _run(engine, [a]) + _run(engine, [b])
     assert got == want
     assert engine.metrics.prefix_cache_hits.total() == 1
+
+
+# ---------------------------------------------------------------------------
+# Host tier (paged mode): eviction spills prefix pages to host RAM; a later
+# request whose prefix is gone from HBM restores the pages instead of
+# re-prefilling. Every test is token-parity: tier traffic must be invisible
+# in the output stream.
+# ---------------------------------------------------------------------------
+
+from aws_k8s_ansible_provisioner_tpu.serving import chaos as _chaos
+
+PS = 8
+
+
+def _paged_engine(model, **kw):
+    cfg, params = model
+    base = dict(max_decode_slots=4, max_cache_len=64, page_size=PS,
+                prefill_buckets=(8, 16, 32, 64), dtype="float32", paged=True,
+                kv_pool_pages=10, kv_host_tier_bytes=1 << 22)
+    base.update(kw)
+    return Engine(cfg, params, ServingConfig(weights_dtype="bf16", **base))
+
+
+@pytest.fixture(scope="module")
+def paged_model():
+    cfg = tiny_qwen3()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def _paged_drain(eng):
+    while (any(s is not None for s in eng.slot_req) or eng.pending
+           or eng._chunk is not None):
+        eng.step()
+
+
+def _paged_run(eng, prompt, max_tokens=6):
+    r = eng.submit(Request(prompt_ids=list(prompt), max_tokens=max_tokens,
+                           ignore_eos=True))
+    _paged_drain(eng)
+    return r.generated
+
+
+def _tier_prompts(seed=11):
+    """One reusable prompt + two fillers, each 33 tokens = 5 pages with the
+    decode tail. Pool is 10 pages, so running A then B then C forces A's
+    indexed prefix pages off HBM (into the host tier when one is attached)."""
+    rng = np.random.default_rng(seed)
+    a = rng.integers(2, 128, 33).tolist()
+    b = rng.integers(2, 128, 33).tolist()
+    c = rng.integers(2, 128, 33).tolist()
+    return a, b, c
+
+
+def test_host_tier_spill_restore_token_parity(paged_model):
+    """After A's pages are evicted to host, re-running A must restore from
+    host RAM (tier hit + restore bytes) and emit exactly the cold tokens."""
+    a, b, c = _tier_prompts()
+    eng = _paged_engine(paged_model)
+    cold = _paged_run(eng, a)
+    _paged_run(eng, b)
+    _paged_run(eng, c)                       # evicts A's prefix pages -> spill
+
+    tier = eng.host_tier
+    assert tier is not None and tier.spilled_pages > 0
+    assert eng.metrics.kv_spill_bytes.total() > 0
+
+    warm = _paged_run(eng, a)
+    assert warm == cold                       # byte-identical stream
+    assert eng.metrics.prefix_tier_hits.value(tier="host") >= 1
+    assert eng.metrics.kv_restore_bytes.total() > 0
+    assert tier.restored_pages > 0
+    for alloc in eng.allocators:
+        assert alloc.stats()["pages_live"] == 0
+
+
+def test_host_tier_zero_budget_byte_identity(paged_model):
+    """--kv-host-tier-bytes 0 is the escape hatch: no tier object, no host
+    hits, and the stream is byte-identical to the tier-on engine's."""
+    a, b, c = _tier_prompts(seed=12)
+    on = _paged_engine(paged_model)
+    outs_on = [_paged_run(on, p) for p in (a, b, c, a)]
+
+    off = _paged_engine(paged_model, kv_host_tier_bytes=0)
+    assert off.host_tier is None
+    outs_off = [_paged_run(off, p) for p in (a, b, c, a)]
+
+    assert outs_off == outs_on
+    assert off.metrics.prefix_tier_hits.value(tier="host") == 0
+    assert off.metrics.kv_spill_bytes.total() == 0
+    for alloc in off.allocators:
+        assert "host_tier" not in alloc.stats()
+
+
+def test_host_tier_restore_races_concurrent_hit(paged_model):
+    """Two requests sharing the evicted prefix admitted back-to-back: each
+    restore must take its own pages with clean refcounts — after drain every
+    page is released exactly once (pages_live == 0) and both streams match
+    the cold run."""
+    a, b, c = _tier_prompts(seed=13)
+    eng = _paged_engine(paged_model)
+    cold = _paged_run(eng, a)
+    _paged_run(eng, b)
+    _paged_run(eng, c)
+
+    r1 = eng.submit(Request(prompt_ids=list(a), max_tokens=6, ignore_eos=True))
+    r2 = eng.submit(Request(prompt_ids=list(a), max_tokens=6, ignore_eos=True))
+    _paged_drain(eng)
+    assert r1.generated == cold
+    assert r2.generated == cold
+    for alloc in eng.allocators:
+        st = alloc.stats()
+        assert st["pages_live"] == 0
+        assert st["pages_free"] + st["pages_evictable"] == st["pages_total"]
+
+
+def test_kv_offload_error_drops_not_corrupts(paged_model):
+    """Chaos 'kv_offload_error' corrupts the host entries mid-restore: the
+    engine must detect the damage, drop the restore, and fall back to a full
+    re-prefill — wrong tokens are never an option."""
+    a, b, c = _tier_prompts(seed=14)
+    _chaos.reset()
+    try:
+        eng = _paged_engine(paged_model)
+        cold = _paged_run(eng, a)
+        _paged_run(eng, b)
+        _paged_run(eng, c)
+        assert eng.host_tier.spilled_pages > 0
+
+        _chaos.get().inject("kv_offload_error", times=1)
+        warm = _paged_run(eng, a)
+        assert warm == cold                   # fell back, did not corrupt
+        assert eng.metrics.kv_restore_dropped.total() >= 1
+        assert eng.host_tier.dropped_invalid >= 1
+        assert eng.metrics.prefix_tier_hits.value(tier="host") == 0
+    finally:
+        _chaos.reset()

@@ -800,3 +800,45 @@ def test_untagged_stream_death_still_truncates():
         for b in (b1, b2):
             b.shutdown()
         RouterHandler.pool, RouterHandler.metrics = old
+
+
+def test_migrate_affinity_bulk_repoints():
+    """migrate_affinity moves every entry on src to dst in one pass and
+    reports the count; entries on other replicas are untouched."""
+    pool = _frozen_pool(["a:1", "b:1", "c:1"])
+    pool.note_affinity("k1", "a:1")
+    pool.note_affinity("k2", "a:1")
+    pool.note_affinity("k3", "b:1")
+    assert pool.migrate_affinity("a:1", "c:1") == 2
+    assert pool._affinity == {"k1": "c:1", "k2": "c:1", "k3": "b:1"}
+    assert pool.migrate_affinity("a:1", "c:1") == 0   # idempotent
+
+
+def test_remove_backend_repoints_affinity_death_then_rehit():
+    """Replica death must RE-POINT (not drop) its affinity cohort: the next
+    same-prefix request lands on one surviving replica — re-seeding the
+    prefix chain there once — instead of scattering the cohort round-robin
+    across the pool."""
+    pool = _frozen_pool(["a:1", "b:1", "c:1"])
+    pool.note_affinity("k1", "a:1")
+    pool.note_affinity("k2", "a:1")
+    # b is the least-loaded survivor by fresh /load sample
+    pool.note_load("b:1", active=0, queued=0)
+    pool.note_load("c:1", active=5, queued=2)
+
+    assert pool.remove_backend("a:1")
+    # whole cohort re-pointed to the SAME survivor (least-loaded b)
+    assert pool._affinity == {"k1": "b:1", "k2": "b:1"}
+    # death-then-rehit: both keys now stick to b on every pick
+    for key in ("k1", "k2"):
+        for _ in range(3):
+            assert pool.pick(key)[0] == "b:1"
+
+
+def test_remove_backend_drops_affinity_without_survivors():
+    """No survivor to point at -> entries drop (pick() must not chase a
+    removed replica)."""
+    pool = _frozen_pool(["a:1"])
+    pool.note_affinity("k1", "a:1")
+    pool.remove_backend("a:1")
+    assert pool._affinity == {}

@@ -36,6 +36,9 @@ HIGHER_BETTER = frozenset({
     # mixed-feature A/B (BENCH_mixedfeat): feature traffic's throughput,
     # its plain baseline, and the ratio the 10%-tax bound is asserted on
     "plain_toks_per_s", "mixedfeat_toks_per_s", "mixedfeat_ratio",
+    # host-tier A/B (BENCH_prefixtier): warm-restore-vs-cold-re-prefill
+    # TTFT ratio the >= 3x bound is asserted on
+    "prefixtier_speedup",
 })
 # latencies, bubbles, ready times
 LOWER_BETTER = frozenset({
@@ -48,6 +51,8 @@ LOWER_BETTER = frozenset({
     # autoscale ramp (AUTOSCALE_BENCH.json "ramp" block): reaction time,
     # worst shed while the fleet caught up, non-429 failures during drain
     "time_to_first_scale_up_s", "peak_shed_rate", "drain_errors",
+    # host-tier A/B (BENCH_prefixtier): both TTFTs are latencies
+    "warmhost_ttft_ms", "coldprefill_ttft_ms",
 })
 
 

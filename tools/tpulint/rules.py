@@ -710,12 +710,18 @@ def r7_manifest_flags(project: Project) -> List[Finding]:
 # The dispatch half of the decode pipeline must stay fire-and-forget: a
 # blocking read inside these functions serializes device and host again,
 # silently reintroducing the per-dispatch bubble the pipeline exists to
-# hide. The fetch helper is the one sanctioned block point.
+# hide. The fetch helper is the one sanctioned block point. The tier-2 KV
+# spill/restore helpers (ISSUE 20) run on the admission/growth path under
+# the same discipline: gathers, host->device puts and the restore scatter
+# are enqueue-only; their settle (_settle_restore, at chunk start) is
+# sanctioned like _decode_fetch.
 _R8_DISPATCH_FNS = {"_do_decode", "_decode_dispatch",
                     "_drain_decode_pipeline", "_decode_operands",
                     "_mixed_dispatch", "_advance_chunk_mixed",
-                    "_settle_inflight", "_allow_words", "_allow_row"}
-_R8_SANCTIONED_FNS = {"_decode_fetch"}
+                    "_settle_inflight", "_allow_words", "_allow_row",
+                    "_spill_reclaimed", "_schedule_restore",
+                    "_settle_restore"}
+_R8_SANCTIONED_FNS = {"_decode_fetch", "_settle_restore"}
 _R8_BLOCKING_ATTRS = {"block_until_ready", "device_get"}
 
 
@@ -724,15 +730,18 @@ def r8_decode_blocking(project: Project) -> List[Finding]:
     """Inside the decode dispatch-path functions (``_do_decode``,
     ``_decode_dispatch``, ``_drain_decode_pipeline``, ``_decode_operands``,
     the ragged mixed path's ``_mixed_dispatch`` / ``_advance_chunk_mixed``,
-    and the feature-path plumbing ``_settle_inflight`` / ``_allow_words`` /
+    the feature-path plumbing ``_settle_inflight`` / ``_allow_words`` /
     ``_allow_row`` — the guided-mask builders must UPLOAD asynchronously,
-    never read back) in serving/, any host-blocking device read — ``np.asarray(...)``,
-    ``jax.device_get(...)``, ``<x>.block_until_ready()`` — is a finding:
-    it re-serializes the one-deep pipeline and the bubble metric stops
-    measuring anything. The deferred block point is ``_decode_fetch`` and
-    only ``_decode_fetch``; code that must materialize there calls it. A
-    reasoned ``# tpulint: disable=R8`` pragma escapes the rule (e.g. a
-    debug assert)."""
+    never read back — and the tier-2 KV helpers ``_spill_reclaimed`` /
+    ``_schedule_restore``, whose gathers and restore scatters must be
+    enqueue-only) in serving/, any host-blocking device read —
+    ``np.asarray(...)``, ``jax.device_get(...)``,
+    ``<x>.block_until_ready()`` — is a finding: it re-serializes the
+    one-deep pipeline and the bubble metric stops measuring anything. The
+    deferred block points are ``_decode_fetch`` and the restore settle
+    ``_settle_restore``, and only those; code that must materialize there
+    calls them. A reasoned ``# tpulint: disable=R8`` pragma escapes the
+    rule (e.g. a debug assert)."""
     out: List[Finding] = []
     for f in project.serving_files():
         for node, ancestors in _walk_with_stack(f.tree):

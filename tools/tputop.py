@@ -155,6 +155,20 @@ def _row(addr: str, ent: dict, hist=None) -> list:
     pages_t = h.get("kv_pages_total") or 0
     pages_u = h.get("kv_pages_in_use") or 0
     pages = f"{pages_u}/{pages_t}" if pages_t else "-"
+    # tier-2 residue tags (ISSUE 20): evictable-page count and the
+    # {hbm,host,miss} prefix-hit split, appended only when the replica
+    # reports them (pre-tier replicas keep the bare "used/total" cell, and
+    # scripts keyed on the first token of row.split() are unaffected —
+    # same contract as the drain tags below).
+    ev = h.get("kv_pages_evictable")
+    if pages_t and ev:
+        pages += f" e{int(ev)}"
+    tiers = h.get("prefix_tier_hits")
+    if isinstance(tiers, dict) and any(tiers.get(t)
+                                       for t in ("hbm", "host", "miss")):
+        pages += (f" H{int(tiers.get('hbm', 0))}"
+                  f"/h{int(tiers.get('host', 0))}"
+                  f"/m{int(tiers.get('miss', 0))}")
     bub = h.get("decode_bubble_pct")
     pipe = h.get("pipeline")
     drain = pipe.get("drain_rate") if isinstance(pipe, dict) else None
